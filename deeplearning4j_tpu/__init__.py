@@ -1,0 +1,23 @@
+"""deeplearning4j_tpu — a TPU-native deep learning framework.
+
+A ground-up JAX/XLA/Pallas re-design with the capabilities of the reference
+deeplearning4j (Java, 2015): configurable stacked networks (dense, output,
+autoencoder, RBM, LSTM, convolutional), classic second-order and first-order
+optimizers with line search, dataset fetchers/iterators, evaluation,
+embedding models (word2vec/glove), clustering/t-SNE, and a distributed
+data-parallel runtime built on jax.sharding meshes and XLA collectives
+instead of Hazelcast/Akka/Spark parameter averaging.
+
+Layer map (reference -> this package):
+  ND4J INDArray/ops        -> deeplearning4j_tpu.nd        (jnp + op registry)
+  nn/conf                  -> deeplearning4j_tpu.nn.conf
+  nn/layers                -> deeplearning4j_tpu.nn.layers (pure init/apply)
+  optimize                 -> deeplearning4j_tpu.optimize
+  datasets                 -> deeplearning4j_tpu.datasets
+  eval                     -> deeplearning4j_tpu.evaluation
+  scaleout (Akka/Spark)    -> deeplearning4j_tpu.parallel  (mesh + psum)
+  nlp                      -> deeplearning4j_tpu.text / models
+  clustering/plot          -> deeplearning4j_tpu.clustering / plot
+"""
+
+__version__ = "0.1.0"

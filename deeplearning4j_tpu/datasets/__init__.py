@@ -1,0 +1,10 @@
+"""datasets — DataSet container, fetchers, iterators (reference L3 parity)."""
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.datasets.iterator import (
+    DataSetIterator,
+    ListDataSetIterator,
+    MultipleEpochsIterator,
+    SamplingDataSetIterator,
+    TestDataSetIterator,
+)

@@ -1,0 +1,89 @@
+"""DataSet — (features, labels) pair.
+
+Parity: ND4J `org.nd4j.linalg.dataset.DataSet` as consumed throughout the
+reference (65 imports): merge, normalization, binarization, shuffle,
+`splitTestAndTrain`, batching, `numExamples`.  Host-side numpy (data prep
+stays off-device; arrays move to TPU only inside jitted steps).
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, List, Sequence, Tuple
+
+import numpy as np
+
+
+class DataSet:
+    def __init__(self, features, labels=None):
+        self.features = np.asarray(features)
+        self.labels = (np.asarray(labels) if labels is not None
+                       else np.zeros((len(self.features), 0), np.float32))
+
+    # -- basics ------------------------------------------------------------
+    def num_examples(self) -> int:
+        return int(self.features.shape[0])
+
+    def num_inputs(self) -> int:
+        return int(np.prod(self.features.shape[1:]))
+
+    def num_outcomes(self) -> int:
+        return int(self.labels.shape[-1]) if self.labels.ndim > 1 else 0
+
+    def __len__(self) -> int:
+        return self.num_examples()
+
+    def __iter__(self):
+        for i in range(self.num_examples()):
+            yield DataSet(self.features[i:i + 1], self.labels[i:i + 1])
+
+    def get(self, idx) -> "DataSet":
+        return DataSet(self.features[idx], self.labels[idx])
+
+    def copy(self) -> "DataSet":
+        return DataSet(self.features.copy(), self.labels.copy())
+
+    # -- transforms --------------------------------------------------------
+    @staticmethod
+    def merge(datasets: Sequence["DataSet"]) -> "DataSet":
+        return DataSet(
+            np.concatenate([d.features for d in datasets], axis=0),
+            np.concatenate([d.labels for d in datasets], axis=0),
+        )
+
+    def shuffle(self, seed: int = 123) -> "DataSet":
+        rng = np.random.RandomState(seed)
+        idx = rng.permutation(self.num_examples())
+        return DataSet(self.features[idx], self.labels[idx])
+
+    def normalize_zero_mean_unit_variance(self) -> "DataSet":
+        mean = self.features.mean(axis=0, keepdims=True)
+        std = self.features.std(axis=0, keepdims=True) + 1e-6
+        return DataSet((self.features - mean) / std, self.labels)
+
+    def scale_to_unit(self) -> "DataSet":
+        mx = np.abs(self.features).max() or 1.0
+        return DataSet(self.features / mx, self.labels)
+
+    def binarize(self, threshold: float = 0.0) -> "DataSet":
+        return DataSet((self.features > threshold).astype(np.float32), self.labels)
+
+    def split_test_and_train(self, n_train: int, seed: int = 123
+                             ) -> Tuple["DataSet", "DataSet"]:
+        shuffled = self.shuffle(seed)
+        return shuffled.get(slice(0, n_train)), shuffled.get(slice(n_train, None))
+
+    def batch_by(self, batch_size: int) -> List["DataSet"]:
+        return [self.get(slice(i, i + batch_size))
+                for i in range(0, self.num_examples(), batch_size)]
+
+    def sample(self, n: int, seed: int = 123) -> "DataSet":
+        rng = np.random.RandomState(seed)
+        idx = rng.choice(self.num_examples(), size=n, replace=n > self.num_examples())
+        return self.get(idx)
+
+
+def labels_to_one_hot(labels: Iterable[int], n_classes: int) -> np.ndarray:
+    labels = np.asarray(list(labels), np.int64)
+    out = np.zeros((len(labels), n_classes), np.float32)
+    out[np.arange(len(labels)), labels] = 1.0
+    return out

@@ -1,0 +1,158 @@
+"""Dataset fetchers + ready-made iterators.
+
+Parity: reference `datasets/fetchers/*` (`MnistDataFetcher.java:39` with its
+binarization threshold of 30/255, `IrisDataFetcher`, `LFWDataFetcher`,
+`CurvesDataFetcher`, `CSVDataFetcher`) and the `datasets/iterator/impl/*`
+convenience iterators (MnistDataSetIterator, IrisDataSetIterator, ...).
+
+Fetch semantics in a zero-egress environment: real data is used when
+available on disk (IDX MNIST via `MNIST_DIR`/~/MNIST; sklearn's bundled
+iris/digits/lfw loaders), otherwise a deterministic synthetic stand-in with
+identical shapes/classes is generated so tests and benchmarks are hermetic.
+"""
+
+from __future__ import annotations
+
+import csv as csv_mod
+import os
+from typing import Optional
+
+import numpy as np
+
+from deeplearning4j_tpu.datasets import mnist as mnist_mod
+from deeplearning4j_tpu.datasets.dataset import DataSet, labels_to_one_hot
+from deeplearning4j_tpu.datasets.iterator import DataSetIterator, ListDataSetIterator
+
+
+class BaseDataFetcher:
+    """Loads a whole corpus once, serves DataSet curs (BaseDataFetcher parity)."""
+
+    def fetch(self, num_examples: int) -> DataSet:
+        raise NotImplementedError
+
+
+class IrisDataFetcher(BaseDataFetcher):
+    NUM_EXAMPLES = 150
+
+    def fetch(self, num_examples: int = 150) -> DataSet:
+        from sklearn.datasets import load_iris
+
+        X, y = load_iris(return_X_y=True)
+        X = X.astype(np.float32)
+        n = min(num_examples, len(X))
+        return DataSet(X[:n], labels_to_one_hot(y[:n], 3))
+
+
+class MnistDataFetcher(BaseDataFetcher):
+    """MNIST with binarization threshold parity (ref threshold 30: pixels are
+    0..255; here features are already /255 so the threshold is 30/255)."""
+
+    def __init__(self, binarize: bool = True, train: bool = True):
+        self.binarize = binarize
+        self.train = train
+
+    def fetch(self, num_examples: int = 60000) -> DataSet:
+        d = mnist_mod.find_mnist_dir()
+        if d is not None:
+            X, y = mnist_mod.load_real_mnist(d, self.train)
+            X, y = X[:num_examples], y[:num_examples]
+        else:
+            X, y = mnist_mod.synthetic_mnist(num_examples)
+        if self.binarize:
+            X = (X > 30.0 / 255.0).astype(np.float32)
+        return DataSet(X, labels_to_one_hot(y, 10))
+
+
+class LFWDataFetcher(BaseDataFetcher):
+    """Labeled Faces in the Wild; synthetic fallback keeps shapes (62x47)."""
+
+    def __init__(self, n_classes: int = 10):
+        self.n_classes = n_classes
+
+    def fetch(self, num_examples: int = 1000) -> DataSet:
+        try:
+            from sklearn.datasets import fetch_lfw_people
+
+            lfw = fetch_lfw_people(min_faces_per_person=20, download_if_missing=False)
+            X = lfw.images.astype(np.float32) / 255.0
+            y = lfw.target
+        except Exception:
+            rng = np.random.RandomState(7)
+            centers = rng.rand(self.n_classes, 62 * 47).astype(np.float32)
+            y = rng.randint(0, self.n_classes, size=num_examples)
+            X = centers[y] + 0.1 * rng.randn(num_examples, 62 * 47).astype(np.float32)
+            X = X.reshape(-1, 62, 47)
+        n = min(num_examples, len(X))
+        k = int(y.max()) + 1
+        return DataSet(X[:n].reshape(n, -1), labels_to_one_hot(y[:n], k))
+
+
+class CurvesDataFetcher(BaseDataFetcher):
+    """Synthetic 'curves' dataset (ref downloads a fixed curves.json corpus):
+    smooth random 1-d curves rasterized to 784 features, autoencoder-style
+    (labels == features)."""
+
+    def fetch(self, num_examples: int = 1000) -> DataSet:
+        rng = np.random.RandomState(42)
+        t = np.linspace(0, 1, 784, dtype=np.float32)
+        freqs = rng.rand(num_examples, 3) * 8
+        phases = rng.rand(num_examples, 3) * 2 * np.pi
+        amps = rng.rand(num_examples, 3)
+        X = np.zeros((num_examples, 784), np.float32)
+        for i in range(3):
+            X += amps[:, i:i + 1] * np.sin(2 * np.pi * freqs[:, i:i + 1] * t + phases[:, i:i + 1])
+        X = (X - X.min()) / (X.max() - X.min() + 1e-6)
+        return DataSet(X, X.copy())
+
+
+class CSVDataFetcher(BaseDataFetcher):
+    """CSV -> DataSet with a label column (CSVDataFetcher/record-reader parity)."""
+
+    def __init__(self, path: str, label_column: int = -1, skip_header: bool = False,
+                 n_classes: Optional[int] = None):
+        self.path = path
+        self.label_column = label_column
+        self.skip_header = skip_header
+        self.n_classes = n_classes
+
+    def fetch(self, num_examples: int = int(1e9)) -> DataSet:
+        rows = []
+        with open(self.path, newline="") as f:
+            reader = csv_mod.reader(f)
+            for i, row in enumerate(reader):
+                if self.skip_header and i == 0:
+                    continue
+                if not row:
+                    continue
+                rows.append([float(v) for v in row])
+                if len(rows) >= num_examples:
+                    break
+        arr = np.asarray(rows, np.float32)
+        lc = self.label_column % arr.shape[1]
+        y = arr[:, lc].astype(np.int64)
+        X = np.delete(arr, lc, axis=1)
+        k = self.n_classes or int(y.max()) + 1
+        return DataSet(X, labels_to_one_hot(y, k))
+
+
+# -- convenience iterators (datasets/iterator/impl parity) -----------------
+
+def iris_iterator(batch_size: int = 10, num_examples: int = 150,
+                  shuffle_seed: int = 123) -> DataSetIterator:
+    # iris ships class-sorted; unshuffled minibatches would be single-class
+    data = IrisDataFetcher().fetch(num_examples).shuffle(shuffle_seed)
+    return ListDataSetIterator(data, batch_size)
+
+
+def mnist_iterator(batch_size: int = 10, num_examples: int = 1000,
+                   binarize: bool = True, train: bool = True) -> DataSetIterator:
+    data = MnistDataFetcher(binarize, train).fetch(num_examples)
+    return ListDataSetIterator(data, batch_size)
+
+
+def lfw_iterator(batch_size: int = 10, num_examples: int = 300) -> DataSetIterator:
+    return ListDataSetIterator(LFWDataFetcher().fetch(num_examples), batch_size)
+
+
+def curves_iterator(batch_size: int = 10, num_examples: int = 300) -> DataSetIterator:
+    return ListDataSetIterator(CurvesDataFetcher().fetch(num_examples), batch_size)

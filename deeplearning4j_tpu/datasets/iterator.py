@@ -1,0 +1,161 @@
+"""DataSetIterator family.
+
+Parity: reference `datasets/iterator/DataSetIterator.java:54` (batch(),
+totalExamples(), inputColumns(), reset(), cursor) and the wrappers in
+`datasets/iterator/` — `ListDataSetIterator`, `SamplingDataSetIterator`,
+`MultipleEpochsIterator`, and the test-support `TestDataSetIterator`
+(`datasets/test/TestDataSetIterator.java`).
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, List, Optional
+
+import numpy as np
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+
+
+class DataSetIterator:
+    """Abstract batch iterator over a dataset."""
+
+    def __init__(self, batch_size: int, total_examples: int):
+        self.batch_size = batch_size
+        self._total = total_examples
+        self.cursor = 0
+
+    # contract ------------------------------------------------------------
+    def total_examples(self) -> int:
+        return self._total
+
+    def batch(self) -> int:
+        return self.batch_size
+
+    def input_columns(self) -> int:
+        raise NotImplementedError
+
+    def total_outcomes(self) -> int:
+        raise NotImplementedError
+
+    def reset(self) -> None:
+        self.cursor = 0
+
+    def has_next(self) -> bool:
+        return self.cursor < self._total
+
+    def next(self, num: Optional[int] = None) -> DataSet:
+        raise NotImplementedError
+
+    # pythonic ------------------------------------------------------------
+    def __iter__(self) -> Iterator[DataSet]:
+        self.reset()
+        while self.has_next():
+            yield self.next()
+
+    def __next__(self) -> DataSet:
+        if not self.has_next():
+            raise StopIteration
+        return self.next()
+
+
+class ListDataSetIterator(DataSetIterator):
+    """Batches over an in-memory DataSet (ListDataSetIterator parity)."""
+
+    def __init__(self, data: DataSet, batch_size: int = 10):
+        super().__init__(batch_size, data.num_examples())
+        self.data = data
+
+    def input_columns(self) -> int:
+        return self.data.num_inputs()
+
+    def total_outcomes(self) -> int:
+        return self.data.num_outcomes()
+
+    def next(self, num: Optional[int] = None) -> DataSet:
+        n = num or self.batch_size
+        out = self.data.get(slice(self.cursor, self.cursor + n))
+        self.cursor += n
+        return out
+
+
+class SamplingDataSetIterator(DataSetIterator):
+    """Random-with-replacement sampling batches (SamplingDataSetIterator)."""
+
+    def __init__(self, data: DataSet, batch_size: int, total_batches: int,
+                 seed: int = 123):
+        super().__init__(batch_size, total_batches * batch_size)
+        self.data = data
+        self._rng = np.random.RandomState(seed)
+
+    def input_columns(self) -> int:
+        return self.data.num_inputs()
+
+    def total_outcomes(self) -> int:
+        return self.data.num_outcomes()
+
+    def next(self, num: Optional[int] = None) -> DataSet:
+        n = num or self.batch_size
+        idx = self._rng.choice(self.data.num_examples(), size=n)
+        self.cursor += n
+        return self.data.get(idx)
+
+
+class MultipleEpochsIterator(DataSetIterator):
+    """Replays an underlying iterator for N epochs (MultipleEpochsIterator)."""
+
+    def __init__(self, epochs: int, base: DataSetIterator):
+        super().__init__(base.batch_size, base.total_examples() * epochs)
+        self.epochs = epochs
+        self.base = base
+        self._epoch = 0
+
+    def input_columns(self) -> int:
+        return self.base.input_columns()
+
+    def total_outcomes(self) -> int:
+        return self.base.total_outcomes()
+
+    def reset(self) -> None:
+        super().reset()
+        self._epoch = 0
+        self.base.reset()
+
+    def has_next(self) -> bool:
+        if self.base.has_next():
+            return self._epoch < self.epochs
+        return self._epoch + 1 < self.epochs
+
+    def next(self, num: Optional[int] = None) -> DataSet:
+        if not self.base.has_next():
+            self.base.reset()
+            self._epoch += 1
+        self.cursor += num or self.batch_size
+        return self.base.next(num)
+
+
+class TestDataSetIterator(DataSetIterator):
+    """Wraps any iterator, recording what was served (test support parity)."""
+
+    def __init__(self, base: DataSetIterator):
+        super().__init__(base.batch_size, base.total_examples())
+        self.base = base
+        self.served: List[DataSet] = []
+
+    def input_columns(self) -> int:
+        return self.base.input_columns()
+
+    def total_outcomes(self) -> int:
+        return self.base.total_outcomes()
+
+    def reset(self) -> None:
+        super().reset()
+        self.base.reset()
+
+    def has_next(self) -> bool:
+        return self.base.has_next()
+
+    def next(self, num: Optional[int] = None) -> DataSet:
+        d = self.base.next(num)
+        self.served.append(d)
+        self.cursor = self.base.cursor
+        return d

@@ -1,0 +1,3 @@
+"""evaluation — classifier metrics (reference `eval/` parity)."""
+
+from deeplearning4j_tpu.evaluation.evaluation import ConfusionMatrix, Evaluation

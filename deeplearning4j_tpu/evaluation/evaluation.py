@@ -1,0 +1,110 @@
+"""Evaluation + ConfusionMatrix.
+
+Parity: reference `eval/Evaluation.java:31-226` (`eval(realOutcomes, guesses)`
+accumulates a `ConfusionMatrix<Integer>`; precision/recall/accuracy/f1) and
+`eval/ConfusionMatrix.java`.  Counting is exact host-side integer math; the
+argmax over model output is the only device op.
+"""
+
+from __future__ import annotations
+
+from collections import defaultdict
+from typing import Dict, List, Optional
+
+import numpy as np
+
+
+class ConfusionMatrix:
+    def __init__(self, classes: Optional[List[int]] = None):
+        self._m: Dict[int, Dict[int, int]] = defaultdict(lambda: defaultdict(int))
+        self.classes = set(classes or [])
+
+    def add(self, actual: int, predicted: int, count: int = 1) -> None:
+        self._m[actual][predicted] += count
+        self.classes.add(actual)
+        self.classes.add(predicted)
+
+    def count(self, actual: int, predicted: int) -> int:
+        return self._m[actual][predicted]
+
+    def actual_total(self, actual: int) -> int:
+        return sum(self._m[actual].values())
+
+    def predicted_total(self, predicted: int) -> int:
+        return sum(row[predicted] for row in self._m.values())
+
+    def total(self) -> int:
+        return sum(self.actual_total(a) for a in list(self.classes))
+
+    def to_array(self) -> np.ndarray:
+        classes = sorted(self.classes)
+        arr = np.zeros((len(classes), len(classes)), np.int64)
+        for i, a in enumerate(classes):
+            for j, p in enumerate(classes):
+                arr[i, j] = self.count(a, p)
+        return arr
+
+    def __str__(self) -> str:
+        classes = sorted(self.classes)
+        lines = ["actual\\pred " + " ".join(f"{c:>6}" for c in classes)]
+        for a in classes:
+            lines.append(f"{a:>11} " + " ".join(f"{self.count(a, p):>6}" for p in classes))
+        return "\n".join(lines)
+
+
+class Evaluation:
+    def __init__(self):
+        self.confusion = ConfusionMatrix()
+        self.true_positives: Dict[int, int] = defaultdict(int)
+        self.false_positives: Dict[int, int] = defaultdict(int)
+        self.false_negatives: Dict[int, int] = defaultdict(int)
+
+    def eval(self, real_outcomes, guesses) -> None:
+        """Accumulate from one-hot / probability matrices (Evaluation.eval)."""
+        actual = np.argmax(np.asarray(real_outcomes), axis=-1)
+        pred = np.argmax(np.asarray(guesses), axis=-1)
+        for a, p in zip(actual.ravel(), pred.ravel()):
+            a, p = int(a), int(p)
+            self.confusion.add(a, p)
+            if a == p:
+                self.true_positives[a] += 1
+            else:
+                self.false_positives[p] += 1
+                self.false_negatives[a] += 1
+
+    # -- metrics -----------------------------------------------------------
+    def accuracy(self) -> float:
+        total = self.confusion.total()
+        if not total:
+            return 0.0
+        correct = sum(self.true_positives.values())
+        return correct / total
+
+    def precision(self, cls: Optional[int] = None) -> float:
+        if cls is not None:
+            tp, fp = self.true_positives[cls], self.false_positives[cls]
+            return tp / (tp + fp) if tp + fp else 0.0
+        classes = sorted(self.confusion.classes)
+        return float(np.mean([self.precision(c) for c in classes])) if classes else 0.0
+
+    def recall(self, cls: Optional[int] = None) -> float:
+        if cls is not None:
+            tp, fn = self.true_positives[cls], self.false_negatives[cls]
+            return tp / (tp + fn) if tp + fn else 0.0
+        classes = sorted(self.confusion.classes)
+        return float(np.mean([self.recall(c) for c in classes])) if classes else 0.0
+
+    def f1(self, cls: Optional[int] = None) -> float:
+        p, r = self.precision(cls), self.recall(cls)
+        return 2 * p * r / (p + r) if p + r else 0.0
+
+    def stats(self) -> str:
+        lines = [
+            f"Examples: {self.confusion.total()}",
+            f"Accuracy: {self.accuracy():.4f}",
+            f"Precision: {self.precision():.4f}",
+            f"Recall: {self.recall():.4f}",
+            f"F1: {self.f1():.4f}",
+            str(self.confusion),
+        ]
+        return "\n".join(lines)

@@ -1,0 +1,20 @@
+"""nd — the tensor & ops runtime layer.
+
+Replaces the reference's external ND4J contract (SURVEY.md L0; usage census at
+reference `BaseLayer.java:198,215`, `GradientAdjustment.java:200-226`): n-d
+arrays are `jax.numpy` arrays; the string-keyed elementwise op factory
+(`Nd4j.getExecutioner().getOpFactory().createTransform(name, x)` with
+`.derivative()`) becomes the activation registry in `ops.py` where derivatives
+come from `jax.grad`; distributions (`Nd4j.getDistributions()`) become the
+stateless samplers in `random.py`; `LossFunctions` becomes `losses.py`.
+"""
+
+from deeplearning4j_tpu.nd.ops import (
+    Activation,
+    activate,
+    activation_derivative,
+    get_activation,
+    register_activation,
+)
+from deeplearning4j_tpu.nd.losses import LossFunction, score as loss_score
+from deeplearning4j_tpu.nd import random as ndrandom

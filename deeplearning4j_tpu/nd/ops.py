@@ -1,0 +1,105 @@
+"""Elementwise op (activation) registry with derivatives.
+
+Reference parity: ND4J's string-keyed transform factory — e.g.
+`Nd4j.getExecutioner().execAndReturn(Nd4j.getOpFactory().createTransform(
+conf.getActivationFunction(), x))` and its `.derivative()` twin, as used by
+`MultiLayerNetwork.java:585,663` and `BaseLayer.java:211-225`.
+
+TPU-native design: activations are plain jax-traceable functions registered
+by name.  Derivatives are *not* hand-written tables of formulas — they are
+produced by `jax.vmap(jax.grad(...))`-equivalent elementwise autodiff
+(`jax.vjp` with an ones cotangent), so every registered activation
+automatically has a correct derivative, matching the reference capability of
+`createTransform(name, x).derivative()` without its string dispatch.
+"""
+
+from __future__ import annotations
+
+import enum
+from typing import Callable, Dict
+
+import jax
+import jax.numpy as jnp
+
+
+class Activation(str, enum.Enum):
+    """Activation names understood by layer configs.
+
+    Mirrors the activation strings the reference passes around
+    (`NeuralNetConfiguration.activationFunction`, default "sigmoid").
+    """
+
+    SIGMOID = "sigmoid"
+    TANH = "tanh"
+    RELU = "relu"
+    LEAKY_RELU = "leakyrelu"
+    SOFTMAX = "softmax"
+    SOFTPLUS = "softplus"
+    LINEAR = "linear"
+    IDENTITY = "identity"
+    HARD_TANH = "hardtanh"
+    EXP = "exp"
+    ELU = "elu"
+    GELU = "gelu"
+
+    def __str__(self) -> str:  # so configs serialize to the bare name
+        return self.value
+
+
+_REGISTRY: Dict[str, Callable[[jnp.ndarray], jnp.ndarray]] = {}
+
+
+def register_activation(name: str, fn: Callable[[jnp.ndarray], jnp.ndarray]) -> None:
+    """Register a named elementwise activation (ND4J op-factory parity)."""
+    _REGISTRY[str(name).lower()] = fn
+
+
+def get_activation(name) -> Callable[[jnp.ndarray], jnp.ndarray]:
+    key = str(name).lower()
+    if key not in _REGISTRY:
+        raise KeyError(
+            f"unknown activation '{name}'; known: {sorted(_REGISTRY)}"
+        )
+    return _REGISTRY[key]
+
+
+def activate(name, x: jnp.ndarray) -> jnp.ndarray:
+    return get_activation(name)(x)
+
+
+def activation_derivative(name, x: jnp.ndarray) -> jnp.ndarray:
+    """Elementwise derivative of the named activation evaluated at `x`.
+
+    For softmax (not elementwise) this returns the diagonal d(softmax)/dx
+    term `y * (1 - y)` the reference uses in its output-layer delta algebra;
+    full-Jacobian behavior is obtained by taking `jax.grad` of the loss
+    through `activate`, which is what the training paths actually do.
+    """
+    fn = get_activation(name)
+    key = str(name).lower()
+    if key == "softmax":
+        y = fn(x)
+        return y * (1.0 - y)
+    # Elementwise derivative via vjp with ones cotangent: exact for any
+    # elementwise fn, no per-op hand-written formula needed.
+    y, pullback = jax.vjp(fn, x)
+    (dx,) = pullback(jnp.ones_like(y))
+    return dx
+
+
+def _softmax(x: jnp.ndarray) -> jnp.ndarray:
+    return jax.nn.softmax(x, axis=-1)
+
+
+register_activation("sigmoid", jax.nn.sigmoid)
+register_activation("tanh", jnp.tanh)
+register_activation("relu", jax.nn.relu)
+register_activation("leakyrelu", lambda x: jax.nn.leaky_relu(x, 0.01))
+register_activation("softmax", _softmax)
+register_activation("softplus", jax.nn.softplus)
+register_activation("linear", lambda x: x)
+register_activation("identity", lambda x: x)
+register_activation("hardtanh", lambda x: jnp.clip(x, -1.0, 1.0))
+register_activation("exp", jnp.exp)
+register_activation("elu", jax.nn.elu)
+register_activation("gelu", jax.nn.gelu)

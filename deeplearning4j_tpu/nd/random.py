@@ -1,0 +1,56 @@
+"""Stateless RNG utilities — parity with ND4J `Nd4j.getDistributions()`.
+
+The reference threads a mutable global RNG (`NeuralNetConfiguration.rng`,
+java.util.Random) through every sampler (binomial corruption in
+`BasePretrainNetwork.java:87-91`, RBM Gibbs sampling, dropout in
+`BaseLayer.java:250-262`).  TPU-native design: explicit `jax.random` key
+threading — every stochastic operation takes a key and the caller splits.
+`KeyStream` is a convenience for host-side loops that want sequential keys
+without manual splitting (NOT for use inside jit).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+class KeyStream:
+    """Host-side sequential key dispenser (do not use inside jit)."""
+
+    def __init__(self, seed: int = 123):
+        self._key = jax.random.PRNGKey(seed)
+
+    def next(self) -> jax.Array:
+        self._key, sub = jax.random.split(self._key)
+        return sub
+
+    def __call__(self) -> jax.Array:
+        return self.next()
+
+
+def binomial(key, p, shape, dtype=jnp.float32):
+    """Single-trial binomial sample (Bernoulli(p)) as floats in {0,1}.
+
+    Parity: `Nd4j.getDistributions().createBinomial(1, p)` used for input
+    corruption (`BasePretrainNetwork.java:87-91`) and binomial sampling
+    preprocessors.
+    """
+    return jax.random.bernoulli(key, p, shape).astype(dtype)
+
+
+def normal(key, mean, std, shape, dtype=jnp.float32):
+    return mean + std * jax.random.normal(key, shape, dtype)
+
+
+def uniform(key, lo, hi, shape, dtype=jnp.float32):
+    return jax.random.uniform(key, shape, dtype, minval=lo, maxval=hi)
+
+
+def dropout_mask(key, keep_prob, shape, dtype=jnp.float32):
+    """Inverted-dropout mask; scaling by 1/keep so inference needs no rescale.
+
+    Parity: `BaseLayer.java:250-262` (dropout) / `useDropConnect`.
+    """
+    keep = jax.random.bernoulli(key, keep_prob, shape)
+    return keep.astype(dtype) / jnp.asarray(keep_prob, dtype)

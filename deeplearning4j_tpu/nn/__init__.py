@@ -1,0 +1,1 @@
+"""nn — model core: configs, weight init, layers, the stacked network."""

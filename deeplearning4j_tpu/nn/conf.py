@@ -1,0 +1,321 @@
+"""Configuration system — parity with reference `nn/conf/*`.
+
+Reference: `NeuralNetConfiguration.java:52-115` (~40 per-layer hyperparameter
+fields, fluent Builder at :880-1145, Jackson JSON serde at :809-878) and
+`MultiLayerConfiguration.java:34-46` (layer list, `pretrain`, `backward`,
+per-layer `ConfOverride` hooks at :235+, `InputPreProcessor` map).
+
+TPU-native design: frozen dataclasses.  Frozen ⇒ hashable ⇒ usable as static
+arguments to `jax.jit`; "builder" chaining is `dataclasses.replace`, and the
+reference's `ConfOverride` per-layer hooks become `override(i, **kwargs)`.
+JSON round-trip is capability parity with `toJson/fromJson`.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import json
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional, Tuple
+
+from deeplearning4j_tpu.nd.losses import LossFunction
+from deeplearning4j_tpu.nd.ops import Activation
+from deeplearning4j_tpu.nn.weights import WeightInit
+
+
+class OptimizationAlgorithm(str, enum.Enum):
+    """Parity: `nn/api/OptimizationAlgorithm` + `Solver.java:54-70` dispatch."""
+
+    GRADIENT_DESCENT = "gradient_descent"          # line-searched GD
+    ITERATION_GRADIENT_DESCENT = "iteration_gradient_descent"  # plain SGD steps
+    CONJUGATE_GRADIENT = "conjugate_gradient"
+    LBFGS = "lbfgs"
+    HESSIAN_FREE = "hessian_free"
+
+    def __str__(self) -> str:
+        return self.value
+
+
+class LayerType(str, enum.Enum):
+    DENSE = "dense"
+    OUTPUT = "output"
+    AUTOENCODER = "autoencoder"
+    RBM = "rbm"
+    RECURSIVE_AUTOENCODER = "recursive_autoencoder"
+    LSTM = "lstm"
+    GRAVES_LSTM = "graves_lstm"
+    CONVOLUTION = "convolution"
+    SUBSAMPLING = "subsampling"
+    BATCH_NORM = "batch_norm"
+    EMBEDDING = "embedding"
+
+    def __str__(self) -> str:
+        return self.value
+
+
+class RBMUnit(str, enum.Enum):
+    """RBM visible/hidden unit types — parity: `RBM.java:83-89` (4 x 4)."""
+
+    BINARY = "binary"
+    GAUSSIAN = "gaussian"
+    RECTIFIED = "rectified"
+    SOFTMAX = "softmax"
+
+    def __str__(self) -> str:
+        return self.value
+
+
+class PoolingType(str, enum.Enum):
+    MAX = "max"
+    AVG = "avg"
+    SUM = "sum"
+    NONE = "none"
+
+    def __str__(self) -> str:
+        return self.value
+
+
+@dataclass(frozen=True)
+class Distribution:
+    """Weight-init distribution spec (parity: `nn/conf/distribution`)."""
+
+    kind: str = "normal"  # normal | uniform | binomial
+    mean: float = 0.0
+    std: float = 1.0
+    lo: float = -1.0
+    hi: float = 1.0
+    p: float = 0.5
+
+    def sampler(self):
+        from deeplearning4j_tpu.nd import random as ndr
+
+        if self.kind == "normal":
+            return lambda key, shape: ndr.normal(key, self.mean, self.std, shape)
+        if self.kind == "uniform":
+            return lambda key, shape: ndr.uniform(key, self.lo, self.hi, shape)
+        if self.kind == "binomial":
+            return lambda key, shape: ndr.binomial(key, self.p, shape)
+        raise ValueError(f"unknown distribution kind {self.kind}")
+
+
+@dataclass(frozen=True)
+class NeuralNetConfiguration:
+    """Per-layer hyperparameters (reference `NeuralNetConfiguration.java:52-115`)."""
+
+    layer_type: LayerType = LayerType.DENSE
+    n_in: int = 0
+    n_out: int = 0
+
+    activation: Activation = Activation.SIGMOID
+    weight_init: WeightInit = WeightInit.VI
+    dist: Optional[Distribution] = None
+    loss_function: LossFunction = LossFunction.MCXENT
+
+    # optimization
+    optimization_algo: OptimizationAlgorithm = OptimizationAlgorithm.CONJUGATE_GRADIENT
+    lr: float = 1e-1
+    num_iterations: int = 100
+    momentum: float = 0.5
+    momentum_after: Tuple[Tuple[int, float], ...] = ()  # (iteration, momentum) schedule
+    l1: float = 0.0
+    l2: float = 0.0
+    use_regularization: bool = False
+    use_adagrad: bool = True
+    adagrad_reset_iterations: int = 0  # 0 = never reset (ref: resetAdaGradIterations)
+    constrain_gradient_to_unit_norm: bool = False
+    gradient_clip_norm: float = 0.0  # 0 = off (new capability)
+    minimize: bool = True
+    step_function: str = "default"
+    num_line_search_iterations: int = 20
+    lbfgs_memory: int = 4          # two-loop history (LBFGS.java m=4)
+
+    # stochastic regularization
+    dropout: float = 0.0
+    drop_connect: bool = False
+
+    # pretrain-layer knobs
+    corruption_level: float = 0.3   # denoising AE
+    sparsity: float = 0.0
+    k: int = 1                      # CD-k Gibbs steps (RBM.java:121-201)
+    visible_unit: RBMUnit = RBMUnit.BINARY
+    hidden_unit: RBMUnit = RBMUnit.BINARY
+
+    # conv knobs (NCHW)
+    kernel_size: Tuple[int, int] = (5, 5)
+    stride: Tuple[int, int] = (1, 1)
+    padding: Tuple[int, int] = (0, 0)
+    n_channels: int = 1
+    pooling: PoolingType = PoolingType.MAX
+
+    # misc
+    batch_size: int = 0             # 0 = whatever the iterator yields
+    seed: int = 123
+    dtype: str = "float32"          # params dtype; compute may use bfloat16
+
+    def replace(self, **kwargs) -> "NeuralNetConfiguration":
+        return dataclasses.replace(self, **kwargs)
+
+    # --- JSON serde (parity: toJson/fromJson, NeuralNetConfiguration.java:809-878)
+    def to_dict(self) -> Dict[str, Any]:
+        d = dataclasses.asdict(self)
+        for k, v in d.items():
+            if isinstance(v, enum.Enum):
+                d[k] = v.value
+        if d.get("dist") is not None and isinstance(self.dist, Distribution):
+            d["dist"] = dataclasses.asdict(self.dist)
+        return d
+
+    def to_json(self) -> str:
+        return json.dumps(self.to_dict(), indent=2, sort_keys=True)
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "NeuralNetConfiguration":
+        d = dict(d)
+        conv = {
+            "layer_type": LayerType,
+            "activation": Activation,
+            "weight_init": WeightInit,
+            "loss_function": LossFunction,
+            "optimization_algo": OptimizationAlgorithm,
+            "visible_unit": RBMUnit,
+            "hidden_unit": RBMUnit,
+            "pooling": PoolingType,
+        }
+        for k, e in conv.items():
+            if k in d and d[k] is not None:
+                d[k] = e(d[k])
+        if d.get("dist") is not None:
+            d["dist"] = Distribution(**d["dist"])
+        for k in ("momentum_after",):
+            if k in d and d[k] is not None:
+                d[k] = tuple(tuple(x) for x in d[k])
+        for k in ("kernel_size", "stride", "padding"):
+            if k in d and d[k] is not None:
+                d[k] = tuple(d[k])
+        known = {f.name for f in dataclasses.fields(cls)}
+        d = {k: v for k, v in d.items() if k in known}
+        return cls(**d)
+
+    @classmethod
+    def from_json(cls, s: str) -> "NeuralNetConfiguration":
+        return cls.from_dict(json.loads(s))
+
+
+@dataclass(frozen=True)
+class MultiLayerConfiguration:
+    """Stacked-network config (reference `MultiLayerConfiguration.java:34-46`).
+
+    `confs` is one `NeuralNetConfiguration` per layer (the last is normally an
+    OUTPUT layer).  `pretrain`/`backprop` gate the phases of
+    `MultiLayerNetwork.fit` exactly as the reference's `pretrain`/`backward`
+    flags do (`MultiLayerNetwork.java:928-992`).  `input_preprocessors` maps
+    layer index -> preprocessor name (see nn/layers/preprocessor.py).
+    """
+
+    confs: Tuple[NeuralNetConfiguration, ...] = ()
+    pretrain: bool = False
+    backprop: bool = True
+    use_drop_connect: bool = False
+    damping_factor: float = 10.0
+    input_preprocessors: Tuple[Tuple[int, str], ...] = ()
+
+    @property
+    def n_layers(self) -> int:
+        return len(self.confs)
+
+    def conf(self, i: int) -> NeuralNetConfiguration:
+        return self.confs[i]
+
+    def preprocessor(self, i: int) -> Optional[str]:
+        for idx, name in self.input_preprocessors:
+            if idx == i:
+                return name
+        return None
+
+    def override(self, i: int, **kwargs) -> "MultiLayerConfiguration":
+        """Per-layer override hook (parity: `ConfOverride`, builder :235+)."""
+        confs = list(self.confs)
+        confs[i] = confs[i].replace(**kwargs)
+        return dataclasses.replace(self, confs=tuple(confs))
+
+    def replace(self, **kwargs) -> "MultiLayerConfiguration":
+        return dataclasses.replace(self, **kwargs)
+
+    def to_json(self) -> str:
+        return json.dumps(
+            {
+                "confs": [c.to_dict() for c in self.confs],
+                "pretrain": self.pretrain,
+                "backprop": self.backprop,
+                "use_drop_connect": self.use_drop_connect,
+                "damping_factor": self.damping_factor,
+                "input_preprocessors": [list(x) for x in self.input_preprocessors],
+            },
+            indent=2,
+            sort_keys=True,
+        )
+
+    @classmethod
+    def from_json(cls, s: str) -> "MultiLayerConfiguration":
+        d = json.loads(s)
+        return cls(
+            confs=tuple(NeuralNetConfiguration.from_dict(c) for c in d["confs"]),
+            pretrain=d.get("pretrain", False),
+            backprop=d.get("backprop", True),
+            use_drop_connect=d.get("use_drop_connect", False),
+            damping_factor=d.get("damping_factor", 10.0),
+            input_preprocessors=tuple(
+                (int(i), str(n)) for i, n in d.get("input_preprocessors", [])
+            ),
+        )
+
+
+class ListBuilder:
+    """Fluent multi-layer builder — parity with the reference's
+    `new NeuralNetConfiguration.Builder()....list(n).override(...).build()`
+    idiom (`MultiLayerConfiguration.Builder`, `MultiLayerTest.java:55-110`).
+    """
+
+    def __init__(self, base: NeuralNetConfiguration, n_layers: int):
+        self._confs = [base] * n_layers
+        self._pretrain = False
+        self._backprop = True
+        self._preprocessors: Dict[int, str] = {}
+
+    def hidden_layer_sizes(self, sizes, n_in: int, n_out: int) -> "ListBuilder":
+        """Set n_in/n_out per layer from input dim, hidden sizes, output dim."""
+        dims = [n_in] + list(sizes) + [n_out]
+        for i in range(len(self._confs)):
+            self._confs[i] = self._confs[i].replace(
+                n_in=dims[i], n_out=dims[i + 1]
+            )
+        return self
+
+    def override(self, i: int, **kwargs) -> "ListBuilder":
+        self._confs[i] = self._confs[i].replace(**kwargs)
+        return self
+
+    def pretrain(self, flag: bool) -> "ListBuilder":
+        self._pretrain = flag
+        return self
+
+    def backprop(self, flag: bool) -> "ListBuilder":
+        self._backprop = flag
+        return self
+
+    def input_preprocessor(self, i: int, name: str) -> "ListBuilder":
+        self._preprocessors[i] = name
+        return self
+
+    def build(self) -> MultiLayerConfiguration:
+        return MultiLayerConfiguration(
+            confs=tuple(self._confs),
+            pretrain=self._pretrain,
+            backprop=self._backprop,
+            input_preprocessors=tuple(sorted(self._preprocessors.items())),
+        )
+
+
+def list_builder(base: NeuralNetConfiguration, n_layers: int) -> ListBuilder:
+    return ListBuilder(base, n_layers)

@@ -1,0 +1,80 @@
+"""Denoising autoencoder (+ recursive AE variant).
+
+Parity: reference `nn/layers/feedforward/autoencoder/AutoEncoder.java:37-111`
+(tied-weight encode/decode with visible bias `vb` from
+`BasePretrainNetwork`/`PretrainParamInitializer`; binomial input corruption
+from `BasePretrainNetwork.java:87-91`; reconstruction-cross-entropy score).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nd import losses as L
+from deeplearning4j_tpu.nd import random as ndr
+from deeplearning4j_tpu.nd.ops import activate
+from deeplearning4j_tpu.nn.layers.base import DenseLayer, _dtype
+from deeplearning4j_tpu.nn.weights import init_weights
+
+
+class AutoEncoder(DenseLayer):
+    @staticmethod
+    def init(key, conf):
+        kw, _ = jax.random.split(key)
+        dist = conf.dist.sampler() if conf.dist is not None else None
+        return {
+            "W": init_weights(kw, (conf.n_in, conf.n_out), conf.weight_init,
+                              dist, _dtype(conf)),
+            "b": jnp.zeros((conf.n_out,), _dtype(conf)),
+            "vb": jnp.zeros((conf.n_in,), _dtype(conf)),
+        }
+
+    @staticmethod
+    def encode(params, conf, x):
+        return activate(conf.activation, x @ params["W"] + params["b"])
+
+    @staticmethod
+    def decode(params, conf, h):
+        return activate(conf.activation, h @ params["W"].T + params["vb"])
+
+    @staticmethod
+    def forward(params, conf, x, key=None, training=False):
+        return AutoEncoder.encode(params, conf, x)
+
+    @staticmethod
+    def reconstruct(params, conf, x):
+        return AutoEncoder.decode(params, conf, AutoEncoder.encode(params, conf, x))
+
+    @staticmethod
+    def pretrain_loss(params, conf, x, key):
+        """Denoising reconstruction loss (corruption_level parity)."""
+        if conf.corruption_level > 0.0 and key is not None:
+            mask = ndr.binomial(key, 1.0 - conf.corruption_level, x.shape, x.dtype)
+            xc = x * mask
+        else:
+            xc = x
+        recon = AutoEncoder.decode(params, conf, AutoEncoder.encode(params, conf, xc))
+        s = L.get_loss(conf.loss_function if str(conf.loss_function) != "mcxent"
+                       else "reconstruction_crossentropy")(x, recon)
+        if conf.use_regularization and conf.l2:
+            s = s + 0.5 * conf.l2 * jnp.sum(params["W"].astype(jnp.float32) ** 2)
+        if conf.sparsity > 0.0:
+            h = AutoEncoder.encode(params, conf, xc)
+            rho_hat = jnp.clip(jnp.mean(h, axis=0), 1e-6, 1 - 1e-6)
+            rho = conf.sparsity
+            s = s + jnp.sum(rho * jnp.log(rho / rho_hat)
+                            + (1 - rho) * jnp.log((1 - rho) / (1 - rho_hat)))
+        return s
+
+    @staticmethod
+    def pretrain_grad_and_score(params, conf, x, key):
+        score, grads = jax.value_and_grad(
+            lambda p: AutoEncoder.pretrain_loss(p, conf, x, key)
+        )(params)
+        return grads, score
+
+    @staticmethod
+    def pretrain_score(params, conf, x, key):
+        """Score-only path for line-search probes (no gradient formed)."""
+        return AutoEncoder.pretrain_loss(params, conf, x, key)

@@ -1,0 +1,102 @@
+"""Dense / feed-forward layers.
+
+Parity: reference `BaseLayer.java:46-408` — param table {"W","b"},
+`activate() = f(x.W + b)` (:211-219), dropout (:250-262), dropconnect;
+`merge` (parameter averaging, :271-273) is subsumed by pytree arithmetic in
+`parallel/averaging.py`.  Plus BatchNorm and Embedding layers (capability the
+reference's config enum gestures at via BASELINE config[2] "ConvolutionLayer
++ BatchNorm").
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nd import random as ndr
+from deeplearning4j_tpu.nd.ops import activate
+from deeplearning4j_tpu.nn.weights import init_weights
+
+
+def _dtype(conf):
+    return jnp.dtype(conf.dtype)
+
+
+class DenseLayer:
+    """f(x.W + b) with optional dropout/dropconnect."""
+
+    @staticmethod
+    def init(key, conf):
+        kw, _ = jax.random.split(key)
+        dist = conf.dist.sampler() if conf.dist is not None else None
+        return {
+            "W": init_weights(kw, (conf.n_in, conf.n_out), conf.weight_init,
+                              dist, _dtype(conf)),
+            "b": jnp.zeros((conf.n_out,), _dtype(conf)),
+        }
+
+    @staticmethod
+    def preout(params, conf, x, key=None, training=False):
+        W = params["W"]
+        if training and conf.drop_connect and key is not None:
+            W = W * ndr.dropout_mask(key, 0.5, W.shape, W.dtype)
+        return x @ W + params["b"]
+
+    @staticmethod
+    def forward(params, conf, x, key=None, training=False):
+        kdrop = kdc = None
+        if key is not None:
+            kdrop, kdc = jax.random.split(key)
+        if training and conf.dropout > 0.0 and kdrop is not None:
+            x = x * ndr.dropout_mask(kdrop, 1.0 - conf.dropout, x.shape, x.dtype)
+        z = DenseLayer.preout(params, conf, x, kdc, training)
+        return activate(conf.activation, z)
+
+
+class BatchNormLayer:
+    """Batch normalization over the feature axis.
+
+    Stateless-from-jit design: running stats live in params under "ema_*" and
+    are updated outside jit by the training loop (or folded in via
+    `forward(..., training=True)` which normalizes with batch stats).
+    """
+
+    @staticmethod
+    def init(key, conf):
+        n = conf.n_out or conf.n_in
+        d = _dtype(conf)
+        return {
+            "gamma": jnp.ones((n,), d),
+            "beta": jnp.zeros((n,), d),
+            "ema_mean": jnp.zeros((n,), d),
+            "ema_var": jnp.ones((n,), d),
+        }
+
+    @staticmethod
+    def forward(params, conf, x, key=None, training=False):
+        eps = 1e-5
+        if training:
+            axes = tuple(range(x.ndim - 1))
+            mean = jnp.mean(x, axis=axes)
+            var = jnp.var(x, axis=axes)
+        else:
+            mean, var = params["ema_mean"], params["ema_var"]
+        xn = (x - mean) / jnp.sqrt(var + eps)
+        return xn * params["gamma"] + params["beta"]
+
+
+class EmbeddingLayer:
+    """Integer ids -> embedding rows (gather; MXU-friendly one-hot matmul for
+    tiny vocabularies is not worth it — XLA lowers gather well on TPU)."""
+
+    @staticmethod
+    def init(key, conf):
+        dist = conf.dist.sampler() if conf.dist is not None else None
+        return {
+            "W": init_weights(key, (conf.n_in, conf.n_out), conf.weight_init,
+                              dist, _dtype(conf)),
+        }
+
+    @staticmethod
+    def forward(params, conf, x, key=None, training=False):
+        return params["W"][x.astype(jnp.int32)]

@@ -1,0 +1,88 @@
+"""Convolution + subsampling (pooling) layers.
+
+Parity-plus: the reference's conv stack is half-stubbed
+(`ConvolutionLayer.java:95-233` returns nulls; `ConvolutionDownSampleLayer.
+java:38-146` does conv2d + pooling via ND4J `Transforms.maxPool/avgPooling/
+sumPooling`; `SubsamplingLayer.java:43` downsample-by-stride).  Per SURVEY §7
+hard-part 7, this module implements *real* forward+backward conv so LeNet /
+VGG configs actually run.
+
+TPU-native design: `lax.conv_general_dilated` in NCHW with filters
+[out_ch, in_ch, kh, kw] — XLA tiles these straight onto the MXU — and
+`lax.reduce_window` pooling.  Backward comes from `jax.grad` through these
+primitives (XLA generates the transposed conv).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from deeplearning4j_tpu.nd.ops import activate
+from deeplearning4j_tpu.nn.conf import PoolingType
+from deeplearning4j_tpu.nn.layers.base import _dtype
+from deeplearning4j_tpu.nn.weights import init_weights
+
+
+def conv2d(x, w, stride=(1, 1), padding=(0, 0)):
+    """NCHW conv: x [B,C,H,W], w [O,C,kh,kw]."""
+    pad = [(padding[0], padding[0]), (padding[1], padding[1])]
+    return lax.conv_general_dilated(
+        x, w, window_strides=tuple(stride), padding=pad,
+        dimension_numbers=("NCHW", "OIHW", "NCHW"))
+
+
+def pool2d(x, kind: PoolingType, window=(2, 2), stride=None):
+    """max/avg/sum pooling over NCHW spatial dims (Transforms.* parity)."""
+    kind = PoolingType(str(kind))
+    if kind == PoolingType.NONE:
+        return x
+    stride = tuple(stride or window)
+    dims = (1, 1) + tuple(window)
+    strides = (1, 1) + stride
+    if kind == PoolingType.MAX:
+        return lax.reduce_window(x, -jnp.inf, lax.max, dims, strides, "VALID")
+    s = lax.reduce_window(x, 0.0, lax.add, dims, strides, "VALID")
+    if kind == PoolingType.SUM:
+        return s
+    return s / (window[0] * window[1])  # AVG
+
+
+class ConvolutionLayer:
+    """Conv2d + bias + activation.  Params: convweights [O,C,kh,kw], convbias [O]
+    (name parity: `ConvolutionParamInitializer.java:37-67`)."""
+
+    @staticmethod
+    def init(key, conf):
+        kh, kw = conf.kernel_size
+        dist = conf.dist.sampler() if conf.dist is not None else None
+        shape = (conf.n_out, conf.n_channels, kh, kw)
+        fan_in = conf.n_channels * kh * kw
+        fan_out = conf.n_out * kh * kw
+        # VI/Glorot over the receptive field, not the raw first two dims
+        r = jnp.sqrt(6.0 / (fan_in + fan_out))
+        if str(conf.weight_init) == "distribution" and dist is not None:
+            W = jnp.asarray(dist(key, shape), _dtype(conf))
+        else:
+            W = jax.random.uniform(key, shape, _dtype(conf), minval=-r, maxval=r)
+        return {"W": W, "b": jnp.zeros((conf.n_out,), _dtype(conf))}
+
+    @staticmethod
+    def forward(params, conf, x, key=None, training=False):
+        z = conv2d(x, params["W"], conf.stride, conf.padding)
+        z = z + params["b"][None, :, None, None]
+        return activate(conf.activation, z)
+
+
+class SubsamplingLayer:
+    """Pooling-only layer (parity: `SubsamplingLayer.java:43`,
+    `ConvolutionDownSampleLayer` pooling modes)."""
+
+    @staticmethod
+    def init(key, conf):
+        return {}
+
+    @staticmethod
+    def forward(params, conf, x, key=None, training=False):
+        return pool2d(x, conf.pooling, conf.kernel_size, conf.stride)

@@ -1,0 +1,68 @@
+"""LSTM layer — fused-gate, scan-based.
+
+Parity: reference `nn/layers/recurrent/LSTM.java:53-531` (karpathy-style
+char-LSTM with one concatenated weight matrix `iFog` of shape
+[(n_in + n_hidden + 1) x 4*n_hidden] — :161-228 — and manual BPTT :83-157).
+
+TPU-native design: the per-timestep Java loop becomes `lax.scan`; the four
+gates stay fused in a single [(n_in + n_out) x 4*n_out] matmul so each step
+is one MXU call; BPTT is `jax.grad` through the scan (no manual derivation);
+batching is first-class (inputs are [batch, time, n_in], vs. the reference's
+single-sequence [time, n_in]).  Decoding/sampling lives in
+`models/char_lstm.py`, not the layer.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn.layers.base import _dtype
+from deeplearning4j_tpu.nn.weights import init_weights
+
+
+class LSTMLayer:
+    @staticmethod
+    def init(key, conf):
+        n_in, n_h = conf.n_in, conf.n_out
+        dist = conf.dist.sampler() if conf.dist is not None else None
+        # fused gate matrix [x;h] -> [i f o g], one bias vector
+        W = init_weights(key, (n_in + n_h, 4 * n_h), conf.weight_init, dist,
+                         _dtype(conf))
+        b = jnp.zeros((4 * n_h,), _dtype(conf))
+        # forget-gate bias init to 1 (standard practice; helps gradient flow)
+        b = b.at[n_h:2 * n_h].set(1.0)
+        return {"W": W, "b": b}
+
+    @staticmethod
+    def _step(params, n_h, carry, x_t):
+        h, c = carry
+        z = jnp.concatenate([x_t, h], axis=-1) @ params["W"] + params["b"]
+        i = jax.nn.sigmoid(z[..., :n_h])
+        f = jax.nn.sigmoid(z[..., n_h:2 * n_h])
+        o = jax.nn.sigmoid(z[..., 2 * n_h:3 * n_h])
+        g = jnp.tanh(z[..., 3 * n_h:])
+        c = f * c + i * g
+        h = o * jnp.tanh(c)
+        return (h, c), h
+
+    @staticmethod
+    def forward(params, conf, x, key=None, training=False):
+        """x: [batch, time, n_in] -> hidden states [batch, time, n_out]."""
+        if x.ndim == 2:  # single sequence [time, n_in] (reference shape)
+            return LSTMLayer.forward(params, conf, x[None], key, training)[0]
+        B, T, _ = x.shape
+        n_h = conf.n_out
+        h0 = jnp.zeros((B, n_h), x.dtype)
+        c0 = jnp.zeros((B, n_h), x.dtype)
+        xs = jnp.swapaxes(x, 0, 1)  # [time, batch, n_in] for scan
+        (_, _), hs = jax.lax.scan(
+            lambda carry, x_t: LSTMLayer._step(params, n_h, carry, x_t),
+            (h0, c0), xs)
+        return jnp.swapaxes(hs, 0, 1)
+
+    @staticmethod
+    def step(params, conf, x_t, h, c):
+        """Single decode step (used by sampling / beam search)."""
+        (h, c), _ = LSTMLayer._step(params, conf.n_out, (h, c), x_t)
+        return h, c

@@ -1,0 +1,45 @@
+"""Input pre/post processors between layers.
+
+Parity: reference `nn/conf/preprocessor/*` (reshape, binomial sampling,
+zero-mean/unit-variance) and the convolution pre/post processors
+(`nn/layers/convolution/preprocessor/*`).  A preprocessor is a named pure
+function `(conf_of_next_layer, x) -> x'` applied before a layer's forward,
+mirroring `MultiLayerNetwork.activationFromPrevLayer` (:472-481).
+
+Names (as used in `MultiLayerConfiguration.input_preprocessors`):
+  "ff_to_conv:<C>:<H>:<W>"  flat [B, C*H*W] -> [B, C, H, W]
+  "conv_to_ff"              [B, C, H, W] -> [B, C*H*W]
+  "rnn_to_ff"               [B, T, F] -> [B*T, F]
+  "ff_to_rnn:<T>"           [B*T, F] -> [B, T, F]
+  "unit_variance"           zero-mean / unit-variance per feature
+  "binomial_sampling"       Bernoulli-sample the activations (needs host rng:
+                            deterministic threshold 0.5 inside jit)
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def apply_preprocessor(name: str, x):
+    if name is None:
+        return x
+    parts = str(name).split(":")
+    kind = parts[0]
+    if kind == "conv_to_ff":
+        return x.reshape(x.shape[0], -1)
+    if kind == "ff_to_conv":
+        c, h, w = (int(p) for p in parts[1:4])
+        return x.reshape(x.shape[0], c, h, w)
+    if kind == "rnn_to_ff":
+        return x.reshape(-1, x.shape[-1])
+    if kind == "ff_to_rnn":
+        t = int(parts[1])
+        return x.reshape(-1, t, x.shape[-1])
+    if kind == "unit_variance":
+        mean = jnp.mean(x, axis=0, keepdims=True)
+        std = jnp.std(x, axis=0, keepdims=True) + 1e-6
+        return (x - mean) / std
+    if kind == "binomial_sampling":
+        return (x > 0.5).astype(x.dtype)
+    raise ValueError(f"unknown preprocessor '{name}'")

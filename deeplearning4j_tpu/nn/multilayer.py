@@ -1,0 +1,249 @@
+"""MultiLayerNetwork — the stacked-network model container.
+
+Parity: reference `nn/multilayer/MultiLayerNetwork.java:59-1530`:
+  fit(iter)            -> pretrain (layer-wise) + finetune/backprop   (:928-992)
+  feedForward/output   -> per-layer activate with InputPreProcessors  (:488-518, :1159)
+  predict              -> row argmax                                   (:1069-1078)
+  score                -> output-layer loss                            (OutputLayer.java:77-90)
+  params()/setParams   -> flat parameter vector pack/unpack
+  merge                -> parameter averaging (see parallel/averaging.py)
+
+TPU-native design: the network is a frozen config + a params pytree (tuple of
+per-layer dicts).  Training compiles ONE XLA program per (config, batch
+shape): the configured solver (optimize.solver) runs its whole iteration
+loop on-device.  Backprop is `jax.grad` through the stacked forward — there
+is no hand-written `backWard`/delta algebra to maintain.  Layer-wise
+pretraining drives each pretrainable layer's `pretrain_grad_and_score`
+through the same solver machinery (`pretrain` flag parity).
+"""
+
+from __future__ import annotations
+
+import logging
+from functools import partial
+from typing import List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.flatten_util import ravel_pytree
+
+from deeplearning4j_tpu.nn.conf import LayerType, MultiLayerConfiguration
+from deeplearning4j_tpu.nn.layers import get_layer
+from deeplearning4j_tpu.nn.layers.preprocessor import apply_preprocessor
+from deeplearning4j_tpu.optimize import solver as solver_mod
+from deeplearning4j_tpu.optimize.listeners import dispatch as dispatch_listeners
+
+log = logging.getLogger("deeplearning4j_tpu")
+
+_PRETRAINABLE = {LayerType.RBM, LayerType.AUTOENCODER,
+                 LayerType.RECURSIVE_AUTOENCODER}
+
+
+def init_params(conf: MultiLayerConfiguration, key) -> tuple:
+    """Initialize every layer's params (ParamInitializer dispatch parity)."""
+    keys = jax.random.split(key, max(1, conf.n_layers))
+    return tuple(
+        get_layer(c.layer_type).init(keys[i], c)
+        for i, c in enumerate(conf.confs)
+    )
+
+
+def feed_forward(conf: MultiLayerConfiguration, params, x, key=None,
+                 training=False, up_to: Optional[int] = None):
+    """Activations after each layer (MultiLayerNetwork.feedForward parity).
+
+    Returns the list of post-layer activations; `up_to` stops early (used by
+    layer-wise pretraining to build a layer's input).
+    """
+    n = conf.n_layers if up_to is None else up_to
+    acts = []
+    keys = (jax.random.split(key, max(1, n)) if key is not None
+            else [None] * max(1, n))
+    for i in range(n):
+        c = conf.conf(i)
+        x = apply_preprocessor(conf.preprocessor(i), x)
+        x = get_layer(c.layer_type).forward(params[i], c, x, keys[i], training)
+        acts.append(x)
+    return acts
+
+
+def network_output(conf, params, x, key=None, training=False):
+    acts = feed_forward(conf, params, x, key, training)
+    return acts[-1] if acts else x
+
+
+def network_loss(conf: MultiLayerConfiguration, params, x, labels, key=None,
+                 training=True):
+    """End-to-end loss: hidden forward + OutputLayer loss (+ L2 across layers)."""
+    from deeplearning4j_tpu.nn.layers.output import OutputLayer
+
+    n = conf.n_layers
+    keys = (jax.random.split(key, n) if key is not None else [None] * n)
+    h = x
+    for i in range(n - 1):
+        c = conf.conf(i)
+        h = apply_preprocessor(conf.preprocessor(i), h)
+        h = get_layer(c.layer_type).forward(params[i], c, h, keys[i], training)
+    out_conf = conf.conf(n - 1)
+    h = apply_preprocessor(conf.preprocessor(n - 1), h)
+    loss = OutputLayer.loss(params[n - 1], out_conf, h, labels, keys[n - 1],
+                            training)
+    if out_conf.use_regularization and out_conf.l2:
+        for i in range(n - 1):
+            if "W" in params[i]:
+                loss = loss + 0.5 * out_conf.l2 * jnp.sum(
+                    params[i]["W"].astype(jnp.float32) ** 2)
+    return loss
+
+
+class MultiLayerNetwork:
+    def __init__(self, conf: MultiLayerConfiguration, seed: Optional[int] = None):
+        self.conf = conf
+        if seed is None:
+            seed = conf.confs[0].seed if conf.confs else 123
+        self._key = jax.random.PRNGKey(seed)
+        self.params: Optional[tuple] = None
+        self.listeners: List = []
+
+    # -- lifecycle ---------------------------------------------------------
+    def _next_key(self):
+        self._key, sub = jax.random.split(self._key)
+        return sub
+
+    def init(self) -> "MultiLayerNetwork":
+        self.params = init_params(self.conf, self._next_key())
+        return self
+
+    def set_listeners(self, listeners) -> None:
+        self.listeners = list(listeners)
+
+    # -- inference ---------------------------------------------------------
+    def feed_forward(self, x):
+        return feed_forward(self.conf, self.params, jnp.asarray(x))
+
+    def output(self, x):
+        return network_output(self.conf, self.params, jnp.asarray(x))
+
+    def predict(self, x):
+        return np.asarray(jnp.argmax(self.output(x), axis=-1))
+
+    def score(self, x, labels) -> float:
+        return float(network_loss(self.conf, self.params, jnp.asarray(x),
+                                  jnp.asarray(labels), key=None, training=False))
+
+    # -- training ----------------------------------------------------------
+    def _finetune_objective(self, x, labels):
+        conf = self.conf
+
+        def loss(params, key):
+            return network_loss(conf, params, x, labels, key, training=True)
+
+        return solver_mod.from_loss(loss)
+
+    def pretrain_layer(self, i: int, x) -> None:
+        """Optimize layer i's unsupervised objective on its own inputs."""
+        c = self.conf.conf(i)
+        impl = get_layer(c.layer_type)
+        x = jnp.asarray(x)
+
+        def gs(p, key):
+            return impl.pretrain_grad_and_score(p, c, x, key)
+
+        def sc(p, key):
+            return impl.pretrain_score(p, c, x, key)
+
+        objective = solver_mod.Objective(grad_and_score=gs, score=sc)
+        new_p, scores = solver_mod.optimize(objective, self.params[i], c,
+                                            self._next_key())
+        params = list(self.params)
+        params[i] = new_p
+        self.params = tuple(params)
+        dispatch_listeners(self.listeners, self, scores)
+
+    def pretrain(self, data) -> None:
+        """Layer-wise pretraining (MultiLayerNetwork.pretrain :149-190)."""
+        for batch in _as_batches(data):
+            x = jnp.asarray(batch[0] if isinstance(batch, tuple) else batch)
+            for i in range(self.conf.n_layers - 1):
+                c = self.conf.conf(i)
+                if LayerType(str(c.layer_type)) not in _PRETRAINABLE:
+                    continue
+                acts = feed_forward(self.conf, self.params, x, up_to=i)
+                layer_in = acts[-1] if acts else x
+                layer_in = apply_preprocessor(self.conf.preprocessor(i), layer_in)
+                self.pretrain_layer(i, layer_in)
+
+    def finetune(self, x, labels) -> None:
+        """Supervised end-to-end optimization (finetune/backprop parity)."""
+        x, labels = jnp.asarray(x), jnp.asarray(labels)
+        out_conf = self.conf.conf(self.conf.n_layers - 1)
+        objective = self._finetune_objective(x, labels)
+        self.params, scores = solver_mod.optimize(
+            objective, self.params, out_conf, self._next_key())
+        dispatch_listeners(self.listeners, self, scores)
+
+    def fit(self, data, labels=None) -> None:
+        """fit(DataSet/ndarray pair/iterator) — MultiLayerNetwork.fit parity."""
+        if self.params is None:
+            self.init()
+        if labels is not None:
+            batches = [(data, labels)]
+        else:
+            batches = _as_batches(data)
+        x = None
+        for batch in batches:
+            x, y = batch if isinstance(batch, tuple) else (batch.features, batch.labels)
+            if self.conf.pretrain:
+                self.pretrain(jnp.asarray(x))
+            if self.conf.backprop:
+                self.finetune(x, y)
+        if x is not None:
+            self._refresh_batchnorm_stats(jnp.asarray(x))
+
+    def _refresh_batchnorm_stats(self, x) -> None:
+        """Recompute BATCH_NORM running (ema) stats from the last fit batch so
+        inference (training=False) normalizes with data statistics rather
+        than the init-time zeros/ones."""
+        if not any(LayerType(str(c.layer_type)) == LayerType.BATCH_NORM
+                   for c in self.conf.confs):
+            return
+        params = list(self.params)
+        h = x
+        for i, c in enumerate(self.conf.confs):
+            h = apply_preprocessor(self.conf.preprocessor(i), h)
+            if LayerType(str(c.layer_type)) == LayerType.BATCH_NORM:
+                axes = tuple(range(h.ndim - 1))
+                p = dict(params[i])
+                p["ema_mean"] = jnp.mean(h, axis=axes)
+                p["ema_var"] = jnp.var(h, axis=axes)
+                params[i] = p
+            h = get_layer(c.layer_type).forward(params[i], c, h, None, False)
+        self.params = tuple(params)
+
+    # -- parameter vector (distributed/averaging contract) -----------------
+    def params_flat(self) -> jnp.ndarray:
+        """Flat parameter vector (parity: `MultiLayerNetwork.params()`)."""
+        flat, _ = ravel_pytree(self.params)
+        return flat
+
+    def set_params_flat(self, flat) -> None:
+        _, unravel = ravel_pytree(self.params)
+        self.params = unravel(jnp.asarray(flat))
+
+    def clone(self) -> "MultiLayerNetwork":
+        net = MultiLayerNetwork(self.conf)
+        net.params = self.params
+        return net
+
+
+def _as_batches(data):
+    """Normalize fit() inputs: iterator of DataSets, single DataSet, array."""
+    if hasattr(data, "features") and hasattr(data, "labels"):
+        return [(data.features, data.labels)]
+    if hasattr(data, "__next__") or hasattr(data, "reset"):
+        return ((d.features, d.labels) for d in data)
+    if isinstance(data, (list,)):
+        return [(d.features, d.labels) if hasattr(d, "features") else d
+                for d in data]
+    return [data]

@@ -1,0 +1,79 @@
+"""Weight initialization — parity with reference `WeightInit` / `WeightInitUtil`.
+
+Reference: `nn/weights/WeightInit.java:25-34` (enum `VI, ZERO, SIZE,
+DISTRIBUTION, NORMALIZED, UNIFORM`) and `nn/weights/WeightInitUtil.java:74-107`:
+  NORMALIZED:  U(0,1) - 0.5, divided by fan-in (shape[0])
+  UNIFORM:     U(-1/fanIn, 1/fanIn)
+  VI:          variance-normalized: U(-r, r) with r = sqrt(6)/sqrt(sum(shape)+1)
+  DISTRIBUTION: sample the configured distribution
+  SIZE:        uniform based on fan-in/fan-out (Glorot-uniform style)
+  ZERO:        zeros
+
+TPU-native: stateless — every initializer takes an explicit PRNG key.
+Also adds the modern schemes (XAVIER/GLOROT, HE/RELU, LECUN) so new models
+aren't limited to the 2015 set.
+"""
+
+from __future__ import annotations
+
+import enum
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+
+class WeightInit(str, enum.Enum):
+    VI = "vi"
+    ZERO = "zero"
+    SIZE = "size"
+    DISTRIBUTION = "distribution"
+    NORMALIZED = "normalized"
+    UNIFORM = "uniform"
+    XAVIER = "xavier"
+    RELU = "relu"
+    LECUN = "lecun"
+
+    def __str__(self) -> str:
+        return self.value
+
+
+def init_weights(
+    key: jax.Array,
+    shape: Sequence[int],
+    scheme=WeightInit.VI,
+    distribution=None,
+    dtype=jnp.float32,
+) -> jnp.ndarray:
+    """Create a weight tensor of `shape` under the named scheme.
+
+    `distribution`, used by DISTRIBUTION, is a callable `(key, shape) -> array`
+    (see `deeplearning4j_tpu.nn.conf.Distribution.sampler`).
+    """
+    shape = tuple(int(s) for s in shape)
+    scheme = WeightInit(str(scheme).lower())
+    fan_in = shape[0] if shape else 1
+    fan_out = shape[1] if len(shape) > 1 else shape[0] if shape else 1
+
+    if scheme == WeightInit.ZERO:
+        return jnp.zeros(shape, dtype)
+    if scheme == WeightInit.NORMALIZED:
+        return ((jax.random.uniform(key, shape) - 0.5) / fan_in).astype(dtype)
+    if scheme == WeightInit.UNIFORM:
+        a = 1.0 / fan_in
+        return jax.random.uniform(key, shape, dtype, minval=-a, maxval=a)
+    if scheme == WeightInit.VI:
+        r = jnp.sqrt(6.0) / jnp.sqrt(sum(shape) + 1.0)
+        return (jax.random.uniform(key, shape) * 2.0 * r - r).astype(dtype)
+    if scheme == WeightInit.SIZE or scheme == WeightInit.XAVIER:
+        r = jnp.sqrt(6.0 / (fan_in + fan_out))
+        return jax.random.uniform(key, shape, dtype, minval=-r, maxval=r)
+    if scheme == WeightInit.RELU:
+        return (jax.random.normal(key, shape) * jnp.sqrt(2.0 / fan_in)).astype(dtype)
+    if scheme == WeightInit.LECUN:
+        return (jax.random.normal(key, shape) * jnp.sqrt(1.0 / fan_in)).astype(dtype)
+    if scheme == WeightInit.DISTRIBUTION:
+        if distribution is None:
+            raise ValueError("WeightInit.DISTRIBUTION requires a distribution")
+        return jnp.asarray(distribution(key, shape), dtype)
+    raise ValueError(f"unknown weight init scheme {scheme}")

@@ -1,0 +1,15 @@
+"""optimize — solvers, updater chain, line search, listeners, terminations.
+
+Parity with reference `optimize/*` (SURVEY §1 L2): `Solver` dispatch keyed by
+`OptimizationAlgorithm`, the `BaseOptimizer` loop, `BackTrackLineSearch`,
+CG / LBFGS / gradient-descent solvers, and the `GradientAdjustment` updater
+(AdaGrad, momentum + schedule, L2, unit-norm, batch scaling).
+
+TPU-native design: every solver is a pure JAX program — the optimization
+loop is `lax.while_loop` over a flat parameter vector (`ravel_pytree`), the
+line search is a bounded inner `lax.while_loop`, so an entire `fit` call
+compiles to a single XLA executable with zero host round-trips.
+"""
+
+from deeplearning4j_tpu.optimize.solver import Solver, optimize
+from deeplearning4j_tpu.optimize.updater import UpdaterState, init_updater, adjust_gradient

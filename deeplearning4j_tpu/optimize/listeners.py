@@ -1,0 +1,52 @@
+"""Iteration listeners + termination constants.
+
+Parity: reference `optimize/api/IterationListener.java`,
+`listeners/ScoreIterationListener.java:31-46` (print score every N
+iterations), `optimize/terminations/*`.
+
+Solvers run fully inside XLA, so listeners are invoked host-side over the
+returned per-iteration score array after each `fit` — same observable
+behavior (score every N iterations) without breaking compilation.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Sequence
+
+log = logging.getLogger("deeplearning4j_tpu")
+
+
+class IterationListener:
+    def iteration_done(self, model, iteration: int, score: float) -> None:
+        raise NotImplementedError
+
+
+class ScoreIterationListener(IterationListener):
+    def __init__(self, print_iterations: int = 10):
+        self.print_iterations = max(1, print_iterations)
+
+    def iteration_done(self, model, iteration, score):
+        if iteration % self.print_iterations == 0:
+            log.info("Score at iteration %d is %s", iteration, score)
+
+
+class ComposableIterationListener(IterationListener):
+    def __init__(self, listeners: Sequence[IterationListener]):
+        self.listeners = list(listeners)
+
+    def iteration_done(self, model, iteration, score):
+        for l in self.listeners:
+            l.iteration_done(model, iteration, score)
+
+
+def dispatch(listeners, model, scores) -> None:
+    """Replay per-iteration scores from a finished solver run."""
+    import numpy as np
+
+    scores = np.asarray(scores)
+    for i, s in enumerate(scores):
+        if not np.isfinite(s):
+            continue
+        for l in listeners:
+            l.iteration_done(model, i, float(s))

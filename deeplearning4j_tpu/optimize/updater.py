@@ -1,0 +1,86 @@
+"""Gradient adjustment (the updater chain).
+
+Parity: reference `optimize/GradientAdjustment.java:159-226` — per-variable
+AdaGrad with optional periodic reset, else plain lr scaling; momentum with a
+scheduled `momentumAfter` map; L2 weight decay; unit-norm constraint.
+(The reference also divides by batch size; here losses are already batch
+means, so that scaling is built into the gradient itself.)
+
+TPU-native design: a pure `(conf, iteration, grads, params, state) ->
+(adjusted, state)` transform over pytrees — the functional equivalent of
+optax transforms, kept self-contained so the solver loop can live entirely
+inside one XLA program.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+
+class UpdaterState(NamedTuple):
+    adagrad_hist: object   # pytree like params
+    velocity: object       # pytree like params
+
+
+def init_updater(params) -> UpdaterState:
+    zeros = jax.tree_util.tree_map(jnp.zeros_like, params)
+    return UpdaterState(adagrad_hist=zeros, velocity=zeros)
+
+
+def _momentum_at(conf, iteration):
+    """Scheduled momentum (parity: `momentumAfter` map)."""
+    m = jnp.asarray(conf.momentum, jnp.float32)
+    for it, mom in conf.momentum_after:
+        m = jnp.where(iteration >= it, jnp.asarray(mom, jnp.float32), m)
+    return m
+
+
+def adjust_gradient(conf, iteration, grads, params, state: UpdaterState):
+    """Apply the updater chain; returns (step_direction, new_state).
+
+    The returned value is the *scaled step* (lr folded in), to be subtracted
+    from params — matching how `GradientAdjustment` rewrites the raw gradient
+    in place before the step function applies it.
+    """
+    eps = 1e-8
+    lr = conf.lr
+
+    # L2 weight decay on the raw gradient (before adaptive scaling)
+    if conf.use_regularization and conf.l2:
+        grads = jax.tree_util.tree_map(
+            lambda g, p: g + conf.l2 * p.astype(g.dtype), grads, params)
+
+    hist = state.adagrad_hist
+    if conf.use_adagrad:
+        new_hist = jax.tree_util.tree_map(lambda h, g: h + g * g, hist, grads)
+        if conf.adagrad_reset_iterations > 0:
+            resetting = (iteration % conf.adagrad_reset_iterations) == 0
+            new_hist = jax.tree_util.tree_map(
+                lambda h, g: jnp.where(resetting, g * g, h), new_hist, grads)
+        scaled = jax.tree_util.tree_map(
+            lambda g, h: lr * g / (jnp.sqrt(h) + eps), grads, new_hist)
+        hist = new_hist
+    else:
+        scaled = jax.tree_util.tree_map(lambda g: lr * g, grads)
+
+    mom = _momentum_at(conf, iteration)
+    vel = jax.tree_util.tree_map(
+        lambda v, s: mom.astype(s.dtype) * v + s, state.velocity, scaled)
+    step = vel
+
+    if conf.gradient_clip_norm > 0.0:
+        gn = jnp.sqrt(sum(jnp.sum(jnp.square(x.astype(jnp.float32)))
+                          for x in jax.tree_util.tree_leaves(step)))
+        scale = jnp.minimum(1.0, conf.gradient_clip_norm / (gn + eps))
+        step = jax.tree_util.tree_map(lambda x: x * scale.astype(x.dtype), step)
+
+    if conf.constrain_gradient_to_unit_norm:
+        gn = jnp.sqrt(sum(jnp.sum(jnp.square(x.astype(jnp.float32)))
+                          for x in jax.tree_util.tree_leaves(step)))
+        step = jax.tree_util.tree_map(
+            lambda x: x / (gn + eps).astype(x.dtype), step)
+
+    return step, UpdaterState(adagrad_hist=hist, velocity=vel)

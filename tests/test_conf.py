@@ -1,0 +1,46 @@
+"""Config system: JSON round-trip, overrides, builder (ref conf-test parity)."""
+
+from deeplearning4j_tpu.nd.losses import LossFunction
+from deeplearning4j_tpu.nn.conf import (
+    Distribution,
+    LayerType,
+    MultiLayerConfiguration,
+    NeuralNetConfiguration,
+    OptimizationAlgorithm,
+    list_builder,
+)
+
+
+def test_conf_json_roundtrip():
+    c = NeuralNetConfiguration(
+        layer_type=LayerType.RBM, n_in=784, n_out=500, lr=0.01,
+        loss_function=LossFunction.RECONSTRUCTION_CROSSENTROPY,
+        dist=Distribution(kind="normal", std=0.01),
+        momentum_after=((100, 0.9),),
+    )
+    c2 = NeuralNetConfiguration.from_json(c.to_json())
+    assert c2 == c
+
+
+def test_multilayer_json_roundtrip_and_override():
+    base = NeuralNetConfiguration(n_in=4, n_out=3)
+    mlc = (list_builder(base, 3)
+           .hidden_layer_sizes([8, 6], n_in=4, n_out=3)
+           .override(2, layer_type=LayerType.OUTPUT,
+                     loss_function=LossFunction.MCXENT)
+           .pretrain(False).backprop(True).build())
+    assert mlc.conf(0).n_in == 4 and mlc.conf(0).n_out == 8
+    assert mlc.conf(1).n_in == 8 and mlc.conf(1).n_out == 6
+    assert mlc.conf(2).layer_type == LayerType.OUTPUT
+    mlc2 = MultiLayerConfiguration.from_json(mlc.to_json())
+    assert mlc2 == mlc
+    # per-layer override hook (ConfOverride parity)
+    mlc3 = mlc.override(1, optimization_algo=OptimizationAlgorithm.LBFGS)
+    assert mlc3.conf(1).optimization_algo == OptimizationAlgorithm.LBFGS
+    assert mlc.conf(1).optimization_algo != OptimizationAlgorithm.LBFGS
+
+
+def test_conf_hashable_for_jit_staticness():
+    a = NeuralNetConfiguration(n_in=2, n_out=2)
+    b = NeuralNetConfiguration(n_in=2, n_out=2)
+    assert hash(a) == hash(b) and a == b

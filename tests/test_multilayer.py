@@ -1,0 +1,134 @@
+"""End-to-end: MLP on Iris, pretrain+finetune DBN, LeNet on MNIST-like data.
+
+Parity with reference `MultiLayerTest.java:55-110` (DBN on Iris with the
+conf-override pattern) and the eval tests.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.datasets.fetchers import (
+    IrisDataFetcher, MnistDataFetcher, iris_iterator,
+)
+from deeplearning4j_tpu.evaluation import Evaluation
+from deeplearning4j_tpu.nd.losses import LossFunction
+from deeplearning4j_tpu.nn.conf import (
+    LayerType, NeuralNetConfiguration, OptimizationAlgorithm, PoolingType,
+    list_builder,
+)
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+
+def _iris_mlp_conf(algo=OptimizationAlgorithm.CONJUGATE_GRADIENT, iters=60):
+    base = NeuralNetConfiguration(
+        activation="tanh", weight_init="vi", lr=0.1,
+        optimization_algo=algo, num_iterations=iters, use_adagrad=True,
+        seed=42)
+    return (list_builder(base, 2)
+            .hidden_layer_sizes([10], n_in=4, n_out=3)
+            .override(1, layer_type=LayerType.OUTPUT,
+                      loss_function=LossFunction.MCXENT)
+            .build())
+
+
+def test_mlp_learns_iris():
+    data = IrisDataFetcher().fetch(150).normalize_zero_mean_unit_variance()
+    net = MultiLayerNetwork(_iris_mlp_conf()).init()
+    s0 = net.score(data.features, data.labels)
+    net.fit(data.features, data.labels)
+    s1 = net.score(data.features, data.labels)
+    assert s1 < s0
+    ev = Evaluation()
+    ev.eval(data.labels, net.output(data.features))
+    assert ev.accuracy() > 0.9, ev.stats()
+
+
+def test_mlp_iris_iterator_and_sgd():
+    conf = _iris_mlp_conf(OptimizationAlgorithm.ITERATION_GRADIENT_DESCENT, 20)
+    net = MultiLayerNetwork(conf).init()
+    it = iris_iterator(batch_size=50, num_examples=150)
+    for _ in range(3):
+        it.reset()
+        net.fit(it)
+    ev = Evaluation()
+    data = IrisDataFetcher().fetch(150)
+    ev.eval(data.labels, net.output(data.features))
+    assert ev.accuracy() > 0.7, ev.stats()
+
+
+def test_dbn_pretrain_then_finetune():
+    """RBM-stack DBN (ref MultiLayerTest DBN-on-Iris pattern)."""
+    base = NeuralNetConfiguration(
+        layer_type=LayerType.RBM, activation="sigmoid", lr=0.05,
+        optimization_algo=OptimizationAlgorithm.ITERATION_GRADIENT_DESCENT,
+        num_iterations=30, k=1, seed=7)
+    conf = (list_builder(base, 3)
+            .hidden_layer_sizes([12, 8], n_in=4, n_out=3)
+            .override(2, layer_type=LayerType.OUTPUT,
+                      loss_function=LossFunction.MCXENT, lr=0.1,
+                      optimization_algo=OptimizationAlgorithm.CONJUGATE_GRADIENT,
+                      num_iterations=60)
+            .pretrain(True).backprop(True)
+            .build())
+    data = IrisDataFetcher().fetch(150)
+    # scale features into [0,1] for the binary RBM visible units
+    f = data.features
+    f = (f - f.min(0)) / (f.max(0) - f.min(0) + 1e-6)
+    net = MultiLayerNetwork(conf).init()
+    net.fit(f, data.labels)
+    ev = Evaluation()
+    ev.eval(data.labels, net.output(f))
+    assert ev.accuracy() > 0.85, ev.stats()
+
+
+def test_lenet_on_mnist_like_data():
+    """Conv -> pool -> conv -> pool -> dense -> output (LeNet shape)."""
+    base = NeuralNetConfiguration(
+        activation="relu", lr=0.02, use_adagrad=True, momentum=0.0,
+        optimization_algo=OptimizationAlgorithm.ITERATION_GRADIENT_DESCENT,
+        num_iterations=40, seed=3)
+    conf = (list_builder(base, 6)
+            .override(0, layer_type=LayerType.CONVOLUTION, n_channels=1,
+                      n_out=6, kernel_size=(5, 5))
+            .override(1, layer_type=LayerType.SUBSAMPLING, kernel_size=(2, 2),
+                      stride=(2, 2), pooling=PoolingType.MAX)
+            .override(2, layer_type=LayerType.CONVOLUTION, n_channels=6,
+                      n_out=16, kernel_size=(5, 5))
+            .override(3, layer_type=LayerType.SUBSAMPLING, kernel_size=(2, 2),
+                      stride=(2, 2), pooling=PoolingType.MAX)
+            .override(4, layer_type=LayerType.DENSE, n_in=16 * 4 * 4, n_out=84,
+                      activation="tanh")
+            .override(5, layer_type=LayerType.OUTPUT, n_in=84, n_out=10,
+                      loss_function=LossFunction.MCXENT)
+            .input_preprocessor(0, "ff_to_conv:1:28:28")
+            .input_preprocessor(4, "conv_to_ff")
+            .build())
+    data = MnistDataFetcher(binarize=False).fetch(256)
+    net = MultiLayerNetwork(conf).init()
+    s0 = net.score(data.features, data.labels)
+    for _ in range(2):
+        net.fit(data.features, data.labels)
+    s1 = net.score(data.features, data.labels)
+    assert s1 < s0
+    ev = Evaluation()
+    ev.eval(data.labels, net.output(data.features))
+    assert ev.accuracy() > 0.5, ev.stats()
+
+
+def test_params_flat_roundtrip():
+    net = MultiLayerNetwork(_iris_mlp_conf()).init()
+    flat = net.params_flat()
+    net2 = MultiLayerNetwork(_iris_mlp_conf()).init()
+    net2.set_params_flat(flat)
+    x = np.random.RandomState(0).rand(5, 4).astype(np.float32)
+    np.testing.assert_allclose(net.output(x), net2.output(x), rtol=1e-6)
+
+
+def test_evaluation_metrics():
+    ev = Evaluation()
+    y = np.eye(3)[[0, 1, 2, 0, 1, 2]]
+    p = np.eye(3)[[0, 1, 2, 0, 2, 2]]  # one mistake: actual 1 predicted 2
+    ev.eval(y, p)
+    assert ev.accuracy() == 5 / 6
+    assert ev.confusion.count(1, 2) == 1
+    assert 0 < ev.f1() <= 1

@@ -1,0 +1,75 @@
+"""nd runtime: activations + derivatives, losses, rng, weight init."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nd import losses as L
+from deeplearning4j_tpu.nd import random as ndr
+from deeplearning4j_tpu.nd.ops import activate, activation_derivative
+from deeplearning4j_tpu.nn.weights import WeightInit, init_weights
+
+
+def test_activations_match_closed_forms():
+    x = jnp.linspace(-3, 3, 13)
+    np.testing.assert_allclose(activate("sigmoid", x), 1 / (1 + np.exp(-np.asarray(x))), rtol=1e-6)
+    np.testing.assert_allclose(activate("tanh", x), np.tanh(np.asarray(x)), rtol=1e-6)
+    np.testing.assert_allclose(activate("relu", x), np.maximum(0, np.asarray(x)), rtol=1e-6)
+    sm = activate("softmax", jnp.ones((2, 4)))
+    np.testing.assert_allclose(sm, 0.25 * np.ones((2, 4)), rtol=1e-6)
+
+
+def test_activation_derivatives_autodiff():
+    x = jnp.linspace(-2, 2, 9)
+    s = np.asarray(activate("sigmoid", x))
+    np.testing.assert_allclose(activation_derivative("sigmoid", x), s * (1 - s), rtol=1e-5)
+    t = np.tanh(np.asarray(x))
+    np.testing.assert_allclose(activation_derivative("tanh", x), 1 - t * t, rtol=1e-5)
+
+
+def test_unknown_activation_raises():
+    with pytest.raises(KeyError):
+        activate("nope", jnp.zeros(3))
+
+
+def test_losses_basic_values():
+    y = jnp.array([[1.0, 0.0], [0.0, 1.0]])
+    perfect = jnp.array([[1.0, 0.0], [0.0, 1.0]])
+    bad = jnp.array([[0.5, 0.5], [0.5, 0.5]])
+    assert float(L.mcxent(y, perfect)) < float(L.mcxent(y, bad))
+    assert float(L.mse(y, perfect)) == pytest.approx(0.0, abs=1e-6)
+    assert float(L.squared_loss(y, bad)) == pytest.approx(0.5, abs=1e-5)
+    # every registered loss is finite and differentiable
+    for lf in L.LossFunction:
+        fn = L.get_loss(lf)
+        val = fn(y, jnp.clip(bad, 0.01, 0.99))
+        assert np.isfinite(float(val)), lf
+        g = jax.grad(lambda p: fn(y, p))(bad)
+        assert np.all(np.isfinite(np.asarray(g))), lf
+
+
+def test_rng_samplers():
+    key = jax.random.PRNGKey(0)
+    b = ndr.binomial(key, 0.7, (10000,))
+    assert abs(float(b.mean()) - 0.7) < 0.03
+    n = ndr.normal(key, 2.0, 0.5, (10000,))
+    assert abs(float(n.mean()) - 2.0) < 0.05
+    mask = ndr.dropout_mask(key, 0.5, (10000,))
+    assert abs(float(mask.mean()) - 1.0) < 0.1  # inverted dropout preserves scale
+
+
+def test_weight_init_schemes():
+    key = jax.random.PRNGKey(1)
+    shape = (100, 50)
+    for scheme in WeightInit:
+        if scheme == WeightInit.DISTRIBUTION:
+            w = init_weights(key, shape, scheme, lambda k, s: jax.random.normal(k, s))
+        else:
+            w = init_weights(key, shape, scheme)
+        assert w.shape == shape
+        assert np.all(np.isfinite(np.asarray(w)))
+    assert float(jnp.abs(init_weights(key, shape, "zero")).max()) == 0.0
+    vi = init_weights(key, shape, "vi")
+    r = np.sqrt(6) / np.sqrt(sum(shape) + 1)
+    assert float(jnp.abs(vi).max()) <= r + 1e-6

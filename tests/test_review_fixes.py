@@ -1,0 +1,69 @@
+"""Regression tests for review findings (iterator epochs, BN stats, registry)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.datasets.iterator import ListDataSetIterator, MultipleEpochsIterator
+from deeplearning4j_tpu.nn.conf import (
+    LayerType, MultiLayerConfiguration, NeuralNetConfiguration,
+    OptimizationAlgorithm,
+)
+from deeplearning4j_tpu.nn.layers import get_layer
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+
+def test_multiple_epochs_iterator_exact_epochs():
+    data = DataSet(np.arange(8).reshape(4, 2).astype(np.float32),
+                   np.eye(4, dtype=np.float32))
+    it = MultipleEpochsIterator(2, ListDataSetIterator(data, batch_size=2))
+    batches = list(it)
+    assert len(batches) == 4  # 2 epochs x 2 batches, not 6
+    it.reset()
+    assert len(list(it)) == 4
+
+
+def test_recursive_autoencoder_registered():
+    impl = get_layer(LayerType.RECURSIVE_AUTOENCODER)
+    conf = NeuralNetConfiguration(
+        layer_type=LayerType.RECURSIVE_AUTOENCODER, n_in=6, n_out=4)
+    p = impl.init(jax.random.PRNGKey(0), conf)
+    out = impl.forward(p, conf, jnp.ones((2, 6)))
+    assert out.shape == (2, 4)
+
+
+def test_batchnorm_ema_refreshed_after_fit():
+    confs = (
+        NeuralNetConfiguration(layer_type=LayerType.BATCH_NORM, n_in=4, n_out=4),
+        NeuralNetConfiguration(layer_type=LayerType.OUTPUT, n_in=4, n_out=2,
+                               num_iterations=5,
+                               optimization_algo=OptimizationAlgorithm.ITERATION_GRADIENT_DESCENT),
+    )
+    conf = MultiLayerConfiguration(confs=confs)
+    net = MultiLayerNetwork(conf).init()
+    x = np.random.RandomState(0).rand(32, 4).astype(np.float32) * 5 + 3
+    y = np.eye(2, dtype=np.float32)[np.random.RandomState(1).randint(0, 2, 32)]
+    net.fit(x, y)
+    ema_mean = np.asarray(net.params[0]["ema_mean"])
+    assert np.all(np.abs(ema_mean - x.mean(0)) < 0.5)  # refreshed, not zeros
+
+
+def test_output_layer_regression_head_honors_activation():
+    from deeplearning4j_tpu.nd.losses import LossFunction
+    from deeplearning4j_tpu.nn.layers.output import OutputLayer
+
+    conf = NeuralNetConfiguration(layer_type=LayerType.OUTPUT, n_in=3, n_out=2,
+                                  loss_function=LossFunction.MSE,
+                                  activation="sigmoid")
+    p = OutputLayer.init(jax.random.PRNGKey(0), conf)
+    out = OutputLayer.forward(p, conf, jnp.array([[10.0, -10.0, 10.0]]))
+    assert np.all(np.asarray(out) >= 0) and np.all(np.asarray(out) <= 1)
+
+
+def test_seed_zero_distinct_from_default():
+    conf = MultiLayerConfiguration(confs=(
+        NeuralNetConfiguration(layer_type=LayerType.OUTPUT, n_in=4, n_out=2),))
+    w0 = np.asarray(MultiLayerNetwork(conf, seed=0).init().params[0]["W"])
+    w123 = np.asarray(MultiLayerNetwork(conf, seed=123).init().params[0]["W"])
+    assert not np.allclose(w0, w123)
