@@ -1,0 +1,67 @@
+"""Benchmark — LeNet-5 MNIST training throughput (BASELINE configs[0]).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+The reference publishes no numbers (BASELINE.md); `vs_baseline` is computed
+against an assumed 500 samples/sec for the 2015 CPU-jblas ND4J stack on this
+model — the era-typical figure for full LeNet-5 fwd+bwd on a multicore CPU —
+so the ratio is indicative, not a measured A/B.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+ASSUMED_REFERENCE_SAMPLES_PER_SEC = 500.0
+BATCH = 256
+WARMUP_STEPS = 3
+MEASURE_STEPS = 20
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.models.zoo import lenet5
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.parallel.data_parallel import (
+        DataParallelTrainer, init_train_state, make_dp_train_step)
+    from deeplearning4j_tpu.parallel.mesh import make_mesh, shard_batch
+
+    n_dev = len(jax.devices())
+    mesh = make_mesh({"dp": n_dev})
+    conf = lenet5()
+    net = MultiLayerNetwork(conf, seed=0).init()
+    trainer = DataParallelTrainer(net, mesh, mode="sync")
+
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.rand(BATCH, 784), jnp.float32)
+    y = jnp.asarray(np.eye(10, dtype=np.float32)[rng.randint(0, 10, BATCH)])
+    x, y = shard_batch(mesh, (x, y), "dp")
+
+    key = jax.random.PRNGKey(0)
+    for _ in range(WARMUP_STEPS):
+        trainer.state, s = trainer._step(trainer.state, x, y, key)
+    jax.block_until_ready(trainer.state.params)
+
+    t0 = time.perf_counter()
+    for _ in range(MEASURE_STEPS):
+        trainer.state, s = trainer._step(trainer.state, x, y, key)
+    jax.block_until_ready(trainer.state.params)
+    dt = time.perf_counter() - t0
+
+    samples_per_sec = MEASURE_STEPS * BATCH / dt
+    per_chip = samples_per_sec / n_dev
+    print(json.dumps({
+        "metric": "LeNet5-MNIST train samples/sec/chip",
+        "value": round(per_chip, 2),
+        "unit": "samples/sec/chip",
+        "vs_baseline": round(per_chip / ASSUMED_REFERENCE_SAMPLES_PER_SEC, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

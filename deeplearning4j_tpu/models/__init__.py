@@ -1,0 +1,11 @@
+"""Model zoo + embedding/NLP model families.
+
+zoo       — canonical configs for the BASELINE.json benchmark models
+            (LeNet-5 MNIST, char-LSTM, VGG-style CIFAR ConvNet, MLPs)
+word2vec  — skip-gram with hierarchical softmax + negative sampling
+glove     — co-occurrence weighted least squares
+paragraph_vectors — doc embeddings on top of word2vec
+"""
+
+from deeplearning4j_tpu.models.zoo import (lenet5, mlp, char_lstm,
+                                           vgg_cifar10)

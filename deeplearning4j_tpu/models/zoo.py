@@ -1,0 +1,121 @@
+"""Canonical model configurations — the BASELINE.json benchmark set.
+
+These are *configs*, not classes: the reference expressed LeNet/DBN/LSTM
+as `MultiLayerConfiguration`s over its layer enum (e.g. the DBN-on-Iris
+builder in `MultiLayerTest.java:55-110`); same idea here.  BASELINE.json
+configs: LeNet-5 MNIST, char-LSTM (PTB-style), VGG-style CIFAR-10,
+Word2Vec (see models/word2vec.py), data-parallel MLP.
+"""
+
+from __future__ import annotations
+
+from deeplearning4j_tpu.nn.conf import (Activation, LayerType, LossFunction,
+                                        MultiLayerConfiguration,
+                                        NeuralNetConfiguration,
+                                        OptimizationAlgorithm, PoolingType,
+                                        WeightInit)
+
+SGD = OptimizationAlgorithm.ITERATION_GRADIENT_DESCENT
+
+
+def _base(lr=0.1, iters=1, **kw):
+    return NeuralNetConfiguration(
+        optimization_algo=SGD, lr=lr, num_iterations=iters,
+        activation=Activation.RELU, weight_init=WeightInit.VI,
+        use_adagrad=False, momentum=0.9, **kw)
+
+
+def lenet5(lr: float = 0.05, iterations: int = 1,
+           dtype: str = "float32") -> MultiLayerConfiguration:
+    """LeNet-5 on MNIST (BASELINE configs[0]): 1x28x28 -> conv20@5x5 ->
+    pool2 -> conv50@5x5 -> pool2 -> dense500 -> softmax10."""
+    b = _base(lr=lr, iters=iterations, dtype=dtype)
+    confs = (
+        b.replace(layer_type=LayerType.CONVOLUTION, n_channels=1, n_out=20,
+                  kernel_size=(5, 5), stride=(1, 1)),
+        b.replace(layer_type=LayerType.SUBSAMPLING, kernel_size=(2, 2),
+                  stride=(2, 2), pooling=PoolingType.MAX),
+        b.replace(layer_type=LayerType.CONVOLUTION, n_channels=20, n_out=50,
+                  kernel_size=(5, 5), stride=(1, 1)),
+        b.replace(layer_type=LayerType.SUBSAMPLING, kernel_size=(2, 2),
+                  stride=(2, 2), pooling=PoolingType.MAX),
+        b.replace(layer_type=LayerType.DENSE, n_in=50 * 4 * 4, n_out=500),
+        b.replace(layer_type=LayerType.OUTPUT, n_in=500, n_out=10,
+                  activation=Activation.SOFTMAX,
+                  loss_function=LossFunction.MCXENT),
+    )
+    return MultiLayerConfiguration(
+        confs=confs, pretrain=False, backprop=True,
+        input_preprocessors=((0, "ff_to_conv:1:28:28"), (4, "conv_to_ff")))
+
+
+def mlp(n_in: int, hidden, n_out: int, lr: float = 0.1,
+        iterations: int = 1) -> MultiLayerConfiguration:
+    """Plain MLP (the data-parallel benchmark model, BASELINE configs[4])."""
+    b = _base(lr=lr, iters=iterations)
+    dims = [n_in] + list(hidden) + [n_out]
+    confs = []
+    for i in range(len(dims) - 1):
+        last = i == len(dims) - 2
+        confs.append(b.replace(
+            layer_type=LayerType.OUTPUT if last else LayerType.DENSE,
+            n_in=dims[i], n_out=dims[i + 1],
+            activation=Activation.SOFTMAX if last else Activation.RELU,
+            loss_function=LossFunction.MCXENT))
+    return MultiLayerConfiguration(confs=tuple(confs), backprop=True)
+
+
+def char_lstm(vocab: int, hidden: int = 256, n_layers: int = 1,
+              lr: float = 0.1, iterations: int = 1
+              ) -> MultiLayerConfiguration:
+    """char-LSTM (BASELINE configs[1]; reference `LSTM.java:53` is a
+    1-layer karpathy char-LSTM with fused iFog gates + decoder)."""
+    b = _base(lr=lr, iters=iterations)
+    confs = []
+    for i in range(n_layers):
+        confs.append(b.replace(layer_type=LayerType.LSTM,
+                               n_in=vocab if i == 0 else hidden,
+                               n_out=hidden,
+                               activation=Activation.TANH))
+    confs.append(b.replace(layer_type=LayerType.OUTPUT, n_in=hidden,
+                           n_out=vocab, activation=Activation.SOFTMAX,
+                           loss_function=LossFunction.MCXENT))
+    return MultiLayerConfiguration(
+        confs=tuple(confs), backprop=True,
+        # output layer consumes per-timestep features
+        input_preprocessors=((n_layers, "rnn_to_ff"),))
+
+
+def vgg_cifar10(lr: float = 0.05, iterations: int = 1,
+                width: int = 64) -> MultiLayerConfiguration:
+    """VGG-style ConvNet for CIFAR-10 (BASELINE configs[2]) — conv-conv-pool
+    x3 + batchnorm + dense head.  Exceeds the reference, whose conv layer was
+    stubbed (`ConvolutionLayer.java:95-233`)."""
+    b = _base(lr=lr, iters=iterations)
+
+    def conv(cin, cout):
+        return b.replace(layer_type=LayerType.CONVOLUTION, n_channels=cin,
+                         n_out=cout, kernel_size=(3, 3), stride=(1, 1),
+                         padding=(1, 1))
+
+    def bn(c):
+        return b.replace(layer_type=LayerType.BATCH_NORM, n_in=c, n_out=c)
+
+    def pool():
+        return b.replace(layer_type=LayerType.SUBSAMPLING, kernel_size=(2, 2),
+                         stride=(2, 2), pooling=PoolingType.MAX)
+
+    w = width
+    confs = (
+        conv(3, w), bn(w), pool(),
+        conv(w, 2 * w), bn(2 * w), pool(),
+        conv(2 * w, 4 * w), bn(4 * w), pool(),
+        b.replace(layer_type=LayerType.DENSE, n_in=4 * w * 4 * 4, n_out=256),
+        b.replace(layer_type=LayerType.OUTPUT, n_in=256, n_out=10,
+                  activation=Activation.SOFTMAX,
+                  loss_function=LossFunction.MCXENT),
+    )
+    return MultiLayerConfiguration(
+        confs=confs, backprop=True,
+        input_preprocessors=((0, "ff_to_conv:3:32:32"),
+                             (9, "conv_to_ff")))

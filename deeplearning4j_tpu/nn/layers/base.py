@@ -73,16 +73,29 @@ class BatchNormLayer:
         }
 
     @staticmethod
+    def _feature_axes(x):
+        """Reduction axes: channel axis is 1 for NCHW conv outputs, -1 for
+        dense features."""
+        return (0, 2, 3) if x.ndim == 4 else tuple(range(x.ndim - 1))
+
+    @staticmethod
     def forward(params, conf, x, key=None, training=False):
         eps = 1e-5
+        axes = BatchNormLayer._feature_axes(x)
         if training:
-            axes = tuple(range(x.ndim - 1))
             mean = jnp.mean(x, axis=axes)
             var = jnp.var(x, axis=axes)
         else:
             mean, var = params["ema_mean"], params["ema_var"]
+        if x.ndim == 4:
+            mean = mean[None, :, None, None]
+            var = var[None, :, None, None]
+            gamma = params["gamma"][None, :, None, None]
+            beta = params["beta"][None, :, None, None]
+        else:
+            gamma, beta = params["gamma"], params["beta"]
         xn = (x - mean) / jnp.sqrt(var + eps)
-        return xn * params["gamma"] + params["beta"]
+        return xn * gamma + beta
 
 
 class EmbeddingLayer:

@@ -19,7 +19,16 @@ from deeplearning4j_tpu.nn.layers.base import DenseLayer
 class OutputLayer(DenseLayer):
     @staticmethod
     def forward(params, conf, x, key=None, training=False):
-        z = OutputLayer.preout(params, conf, x, None, training)
+        # input dropout / dropconnect apply here exactly as in DenseLayer
+        # (the reference's OutputLayer inherits BaseLayer's dropout path)
+        kdrop = kdc = None
+        if key is not None:
+            kdrop, kdc = jax.random.split(key)
+        if training and conf.dropout > 0.0 and kdrop is not None:
+            from deeplearning4j_tpu.nd import random as ndr
+            x = x * ndr.dropout_mask(kdrop, 1.0 - conf.dropout, x.shape,
+                                     x.dtype)
+        z = OutputLayer.preout(params, conf, x, kdc, training)
         loss = str(conf.loss_function).lower()
         # The head must match the loss (the reference's OutputLayer is a
         # softmax head; hidden-layer activations leaking into the output of a

@@ -213,7 +213,8 @@ class MultiLayerNetwork:
         for i, c in enumerate(self.conf.confs):
             h = apply_preprocessor(self.conf.preprocessor(i), h)
             if LayerType(str(c.layer_type)) == LayerType.BATCH_NORM:
-                axes = tuple(range(h.ndim - 1))
+                from deeplearning4j_tpu.nn.layers.base import BatchNormLayer
+                axes = BatchNormLayer._feature_axes(h)
                 p = dict(params[i])
                 p["ema_mean"] = jnp.mean(h, axis=axes)
                 p["ema_var"] = jnp.var(h, axis=axes)

@@ -108,7 +108,7 @@ def _line_searched(objective: Objective, params0, conf, key, algo):
     def step(carry, it):
         (x, x_prev, g_prev, d_prev, s_hist, y_hist, hist_n, k, done,
          old_score, prev_alpha) = carry
-        k, kg, ks = jax.random.split(k, 3)
+        k, kg = jax.random.split(k)
         g, score = grad_flat(x, kg)
         gnorm = jnp.linalg.norm(g)
 
@@ -164,9 +164,12 @@ def _line_searched(objective: Objective, params0, conf, key, algo):
         # adaptive initial trial: grow from the last accepted step (the
         # reference's BaseOptimizer similarly carries `step` across
         # iterations) so flat regions don't pin progress to tiny steps
+        # probes reuse kg: f0 and f(x + alpha*d) must see the SAME noise
+        # realization (dropout mask / corruption) or Armijo compares noise,
+        # not step quality, and stochastic objectives spuriously terminate
         trial = jnp.clip(prev_alpha * 2.0, 1e-3, 1e6)
         alpha, new_score = backtrack(
-            lambda xx: score_flat(xx, ks), x, d, g, score,
+            lambda xx: score_flat(xx, kg), x, d, g, score,
             max_iters=conf.num_line_search_iterations,
             initial_step=trial)
         x_new = x + alpha * d

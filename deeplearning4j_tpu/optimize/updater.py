@@ -26,8 +26,11 @@ class UpdaterState(NamedTuple):
 
 
 def init_updater(params) -> UpdaterState:
-    zeros = jax.tree_util.tree_map(jnp.zeros_like, params)
-    return UpdaterState(adagrad_hist=zeros, velocity=zeros)
+    # two distinct zero trees: sharing one would alias buffers, which
+    # breaks donation (same buffer donated twice) in jitted train steps
+    return UpdaterState(
+        adagrad_hist=jax.tree_util.tree_map(jnp.zeros_like, params),
+        velocity=jax.tree_util.tree_map(jnp.zeros_like, params))
 
 
 def _momentum_at(conf, iteration):

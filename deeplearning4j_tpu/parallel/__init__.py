@@ -1,0 +1,22 @@
+"""Distributed runtime — the TPU-native replacement for the reference's
+scaleout stack (Akka/Hazelcast/Spark/YARN, SURVEY §2 #16-23).
+
+Design: the *data plane* (what Hazelcast/Spark/Avro moved: parameters and
+updates) is XLA collectives over ICI — `psum`/`pmean` inside one compiled
+program on a `jax.sharding.Mesh`.  The *control plane* (what the
+StateTracker did: membership, heartbeats, job routing, status REST) is a
+small host-side coordinator in `coordinator.py`.
+
+Modules:
+  mesh          — device mesh construction (dp/tp/sp/pp axes)
+  averaging     — parameter averaging / aggregation (INDArrayAggregator parity)
+  data_parallel — per-step gradient all-reduce + BSP local-steps-then-average
+  coordinator   — host-side state tracker: workers, heartbeats, jobs, REST
+  checkpoint    — pytree checkpoints (params + updater state + data cursor)
+"""
+
+from deeplearning4j_tpu.parallel.mesh import make_mesh, mesh_axes
+from deeplearning4j_tpu.parallel.averaging import (average_pytrees, merge,
+                                                   ParameterAggregator)
+from deeplearning4j_tpu.parallel.data_parallel import (DataParallelTrainer,
+                                                       make_dp_train_step)

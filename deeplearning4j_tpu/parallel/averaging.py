@@ -1,0 +1,64 @@
+"""Parameter averaging / aggregation.
+
+Parity: reference `scaleout/aggregator/INDArrayAggregator.java:32-62`
+(running sum then divide-by-count), `BaseLayer.merge:271-273` and
+`MultiLayerNetwork.merge:1333` (`a += (b - a) / n`), Spark `Add.java:28`
+fold + divide.
+
+Here parameters are pytrees; averaging is tree arithmetic.  On-mesh the
+same operation is `jax.lax.pmean` inside the compiled step
+(data_parallel.py) — these host-side helpers cover the BSP
+"local k steps then average" mode and cross-host aggregation.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+
+
+def average_pytrees(trees: Sequence):
+    """Element-wise mean over a list of identically-shaped pytrees."""
+    if not trees:
+        raise ValueError("no pytrees to average")
+    n = float(len(trees))
+    return jax.tree_util.tree_map(lambda *xs: sum(xs) / n, *trees)
+
+
+def merge(a, b, n: int):
+    """Running merge `a += (b - a) / n` (BaseLayer.merge parity)."""
+    return jax.tree_util.tree_map(
+        lambda x, y: x + (y - x) / float(n), a, b)
+
+
+class ParameterAggregator:
+    """Streaming aggregator (INDArrayAggregator parity): accumulate worker
+    results one at a time, `aggregate()` returns the average."""
+
+    def __init__(self):
+        self._sum = None
+        self._count = 0
+
+    def accumulate(self, tree) -> None:
+        if self._sum is None:
+            self._sum = jax.tree_util.tree_map(
+                lambda x: jnp.asarray(x, jnp.float32), tree)
+        else:
+            self._sum = jax.tree_util.tree_map(
+                lambda s, x: s + jnp.asarray(x, jnp.float32), self._sum, tree)
+        self._count += 1
+
+    @property
+    def count(self) -> int:
+        return self._count
+
+    def aggregate(self):
+        if self._sum is None:
+            return None
+        n = float(self._count)
+        return jax.tree_util.tree_map(lambda s: s / n, self._sum)
+
+    def reset(self) -> None:
+        self._sum, self._count = None, 0

@@ -1,0 +1,79 @@
+"""Device-mesh construction.
+
+The reference's notion of "cluster" is a set of JVM workers joined through
+Hazelcast (`BaseHazelCastStateTracker.java:49`) or Spark executors; here a
+"cluster" is a `jax.sharding.Mesh` over TPU chips, with named axes for each
+parallelism flavor:
+
+  dp — data parallelism (the reference's only strategy, as true all-reduce)
+  tp — tensor parallelism (sharded weight matrices; new scope beyond ref)
+  sp — sequence/context parallelism (ring attention; new scope)
+  pp — pipeline parallelism (staged layers; new scope)
+  ep — expert parallelism (MoE; new scope)
+
+Axis order places `dp` outermost (gradient all-reduce tolerates lower
+bandwidth) and `tp` innermost (activation collectives want the fastest ICI
+links) — the standard mesh layout recipe.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+# canonical axis order, outermost first
+AXIS_ORDER = ("pp", "dp", "ep", "sp", "tp")
+
+
+def mesh_axes(mesh: Mesh) -> Sequence[str]:
+    return tuple(mesh.axis_names)
+
+
+def make_mesh(shape: Optional[Dict[str, int]] = None,
+              devices=None) -> Mesh:
+    """Build a Mesh from `{axis: size}`; `-1` for one axis means "all
+    remaining devices".  Default: pure data parallelism over every device.
+    """
+    if devices is None:
+        devices = jax.devices()
+    n = len(devices)
+    if not shape:
+        shape = {"dp": n}
+    shape = dict(shape)
+    fills = [a for a, s in shape.items() if s == -1]
+    if len(fills) > 1:
+        raise ValueError("at most one axis may be -1")
+    fixed = math.prod(s for s in shape.values() if s != -1)
+    if fills:
+        if n % fixed:
+            raise ValueError(f"{n} devices not divisible by {fixed}")
+        shape[fills[0]] = n // fixed
+    total = math.prod(shape.values())
+    if total > n:
+        raise ValueError(f"mesh {shape} needs {total} devices, have {n}")
+    axes = [a for a in AXIS_ORDER if a in shape]
+    axes += [a for a in shape if a not in axes]  # user-defined extras
+    dims = [shape[a] for a in axes]
+    dev = np.asarray(devices[:total]).reshape(dims)
+    return Mesh(dev, axis_names=tuple(axes))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def batch_sharding(mesh: Mesh, axis: str = "dp") -> NamedSharding:
+    """Shard the leading (batch) dimension over `axis`."""
+    return NamedSharding(mesh, P(axis))
+
+
+def shard_batch(mesh: Mesh, arrays, axis: str = "dp"):
+    """Place host arrays onto the mesh with the batch dim sharded over
+    `axis` (the device boundary the reference crossed via Hazelcast job
+    slots / Spark broadcast, here a single `device_put`)."""
+    sh = batch_sharding(mesh, axis)
+    return jax.tree_util.tree_map(lambda a: jax.device_put(a, sh), arrays)

@@ -8,7 +8,9 @@ devices so no TPU pod is needed (SURVEY §4 lesson).
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# force CPU even when the ambient env selects a TPU platform: the virtual
+# 8-device mesh only exists on the host platform
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
@@ -16,4 +18,7 @@ if "host_platform_device_count" not in flags:
 
 import jax  # noqa: E402  (import after env is set)
 
+# the ambient axon/TPU plugin overrides JAX_PLATFORMS at import time;
+# re-assert the host platform explicitly
+jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", False)

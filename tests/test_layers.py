@@ -119,3 +119,35 @@ def test_subsampling_layer():
     x = jnp.arange(16.0).reshape(1, 1, 4, 4)
     y = SubsamplingLayer.forward({}, conf, x)
     np.testing.assert_allclose(y[0, 0], [[5.0, 7.0], [13.0, 15.0]])
+
+
+def test_batchnorm_nchw_channel_axis():
+    """BatchNorm after conv normalizes per channel (NCHW), not per column."""
+    import jax, jax.numpy as jnp, numpy as np
+    from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration, LayerType
+    from deeplearning4j_tpu.nn.layers.base import BatchNormLayer
+
+    conf = NeuralNetConfiguration(layer_type=LayerType.BATCH_NORM, n_in=3,
+                                  n_out=3)
+    p = BatchNormLayer.init(jax.random.PRNGKey(0), conf)
+    x = jnp.asarray(np.random.RandomState(0).randn(4, 3, 5, 5),
+                    jnp.float32)
+    y = BatchNormLayer.forward(p, conf, x, training=True)
+    assert y.shape == x.shape
+    # per-channel stats ~ (0, 1)
+    m = np.asarray(jnp.mean(y, axis=(0, 2, 3)))
+    v = np.asarray(jnp.var(y, axis=(0, 2, 3)))
+    np.testing.assert_allclose(m, 0.0, atol=1e-5)
+    np.testing.assert_allclose(v, 1.0, atol=1e-4)
+
+
+def test_vgg_cifar_forward_shape():
+    import jax, jax.numpy as jnp
+    from deeplearning4j_tpu.models.zoo import vgg_cifar10
+    from deeplearning4j_tpu.nn.multilayer import init_params, network_output
+
+    conf = vgg_cifar10(width=8)
+    params = init_params(conf, jax.random.PRNGKey(0))
+    x = jnp.zeros((2, 3 * 32 * 32), jnp.float32)
+    out = network_output(conf, params, x)
+    assert out.shape == (2, 10)

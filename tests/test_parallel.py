@@ -1,0 +1,237 @@
+"""Distributed runtime tests on the virtual 8-device CPU mesh.
+
+The analog of the reference's in-JVM distributed tests
+(`BaseTestDistributed.java:34-98`, `TestDistributed`, `IRUnitDriver`):
+real mesh, real collectives, no pod.
+"""
+
+import json
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nn.conf import (LayerType, NeuralNetConfiguration,
+                                        OptimizationAlgorithm, list_builder)
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.parallel import (DataParallelTrainer, average_pytrees,
+                                         make_mesh, merge,
+                                         ParameterAggregator)
+from deeplearning4j_tpu.parallel import checkpoint as ckpt
+from deeplearning4j_tpu.parallel.coordinator import (LocalRunner, StateTracker,
+                                                     start_rest_api)
+from deeplearning4j_tpu.parallel.data_parallel import (init_train_state,
+                                                       make_sharded_train_step,
+                                                       shard_train_state)
+
+
+def _mlp_conf(n_in=4, n_hidden=8, n_out=3, **kw):
+    base = NeuralNetConfiguration(
+        n_in=n_in, n_out=n_out, lr=0.1,
+        optimization_algo=OptimizationAlgorithm.ITERATION_GRADIENT_DESCENT,
+        num_iterations=5, **kw)
+    return (list_builder(base, 2)
+            .hidden_layer_sizes([n_hidden], n_in, n_out)
+            .override(1, layer_type=LayerType.OUTPUT)
+            .pretrain(False).backprop(True).build())
+
+
+def _toy_data(n=64, n_in=4, n_out=3, seed=0):
+    rng = np.random.RandomState(seed)
+    x = rng.randn(n, n_in).astype(np.float32)
+    w = rng.randn(n_in, n_out)
+    y = np.eye(n_out, dtype=np.float32)[np.argmax(x @ w, axis=1)]
+    return x, y
+
+
+def test_mesh_shapes():
+    mesh = make_mesh()
+    assert mesh.devices.size == 8
+    mesh2 = make_mesh({"dp": 2, "tp": -1})
+    assert mesh2.shape["dp"] == 2 and mesh2.shape["tp"] == 4
+    # dp is outer, tp inner
+    assert mesh2.axis_names == ("dp", "tp")
+
+
+def test_averaging_helpers():
+    a = {"W": jnp.ones((2, 2)), "b": jnp.zeros(2)}
+    b = {"W": 3 * jnp.ones((2, 2)), "b": 2 * jnp.ones(2)}
+    avg = average_pytrees([a, b])
+    assert np.allclose(avg["W"], 2.0) and np.allclose(avg["b"], 1.0)
+    m = merge(a, b, 2)  # a += (b-a)/2
+    assert np.allclose(m["W"], 2.0)
+    agg = ParameterAggregator()
+    agg.accumulate(a)
+    agg.accumulate(b)
+    assert np.allclose(agg.aggregate()["W"], 2.0)
+    assert agg.count == 2
+
+
+def test_dp_sync_training_decreases_loss():
+    mesh = make_mesh({"dp": 8})
+    conf = _mlp_conf()
+    net = MultiLayerNetwork(conf).init()
+    x, y = _toy_data()
+    trainer = DataParallelTrainer(net, mesh, mode="sync")
+    first = None
+    for _ in range(30):
+        s = trainer.fit([(x, y)])
+        if first is None:
+            first = s
+    assert s < first
+
+
+def test_dp_sync_matches_single_device_gradients():
+    """One dp-sync step == one full-batch step on a single device."""
+    conf = _mlp_conf()
+    x, y = _toy_data(n=32)
+    net1 = MultiLayerNetwork(conf, seed=7).init()
+    net2 = MultiLayerNetwork(conf, seed=7).init()
+    mesh8 = make_mesh({"dp": 8})
+    mesh1 = make_mesh({"dp": 1}, devices=jax.devices()[:1])
+    t8 = DataParallelTrainer(net1, mesh8, mode="sync")
+    t1 = DataParallelTrainer(net2, mesh1, mode="sync")
+    s8 = t8.fit([(x, y)])
+    s1 = t1.fit([(x, y)])
+    for p8, p1 in zip(jax.tree_util.tree_leaves(t8.state.params),
+                      jax.tree_util.tree_leaves(t1.state.params)):
+        np.testing.assert_allclose(np.asarray(p8), np.asarray(p1),
+                                   rtol=2e-4, atol=2e-5)
+
+
+def test_bsp_averaging_mode():
+    mesh = make_mesh({"dp": 4}, devices=jax.devices()[:4])
+    conf = _mlp_conf()
+    net = MultiLayerNetwork(conf).init()
+    x, y = _toy_data()
+    trainer = DataParallelTrainer(net, mesh, mode="averaging", local_steps=3)
+    s0 = trainer.fit([(x, y)])
+    for _ in range(15):
+        s = trainer.fit([(x, y)])
+    assert s < s0
+    # params replicated identically after averaging
+    p = trainer.state.params
+    leaf = jax.tree_util.tree_leaves(p)[0]
+    assert len(set(str(d) for d in leaf.sharding.device_set)) >= 1
+
+
+def test_sharded_tp_step_runs():
+    """pjit path with tensor-parallel weight sharding compiles + steps."""
+    mesh = make_mesh({"dp": 2, "tp": 4})
+    conf = _mlp_conf(n_in=4, n_hidden=8, n_out=4)
+    net = MultiLayerNetwork(conf).init()
+    state = shard_train_state(init_train_state(net), mesh)
+    step = make_sharded_train_step(conf, mesh)
+    x, y = _toy_data(n=16, n_out=4)
+    xs = jax.device_put(jnp.asarray(x), jax.NamedSharding(
+        mesh, jax.sharding.PartitionSpec("dp")))
+    ys = jax.device_put(jnp.asarray(y), jax.NamedSharding(
+        mesh, jax.sharding.PartitionSpec("dp")))
+    state2, score = step(state, xs, ys, jax.random.PRNGKey(0))
+    assert np.isfinite(float(score))
+    assert int(state2.step) == 1
+
+
+def test_state_tracker_and_reaper():
+    st = StateTracker(stale_after_s=0.0)
+    st.add_worker("w0")
+    st.add_worker("w1")
+    assert set(st.workers()) == {"w0", "w1"}
+    from deeplearning4j_tpu.parallel.coordinator import Job
+    assert st.route_job("w0", Job(work=1))
+    assert not st.route_job("w0", Job(work=2))  # AlreadyWorking
+    stale = st.reap_stale()
+    assert set(stale) == {"w0", "w1"}
+    # orphaned pending job was requeued
+    assert st.take_unclaimed() is not None
+    st.increment("x", 2.0)
+    assert st.count("x") == 2.0
+
+
+def test_local_runner_bsp_and_rest():
+    def perform(w):
+        return {"v": jnp.asarray(float(w))}
+
+    def aggregate(results):
+        return average_pytrees(results) if results else None
+
+    runner = LocalRunner(perform, aggregate, n_workers=3)
+    out = runner.run(range(9))
+    # average of the last BSP wave or of all, depending on wave bookkeeping;
+    # all 9 results retained across waves
+    assert out is not None and np.isfinite(float(out["v"]))
+    assert runner.tracker.count("jobs_done") == 9
+
+    server, port = start_rest_api(runner.tracker)
+    try:
+        body = json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/statetracker", timeout=5).read())
+        assert body["counters"]["jobs_done"] == 9
+        one = json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/statetracker/numbatchessofar",
+            timeout=5).read())
+        assert "numbatchessofar" in one
+    finally:
+        server.shutdown()
+
+
+def test_local_runner_hogwild():
+    seen = []
+
+    def perform(w):
+        seen.append(w)
+        return {"v": jnp.asarray(1.0)}
+
+    runner = LocalRunner(perform, lambda rs: len(rs), n_workers=2,
+                         hogwild=True)
+    out = runner.run(range(5))
+    assert len(seen) == 5
+
+
+def test_checkpoint_roundtrip(tmp_path):
+    conf = _mlp_conf()
+    net = MultiLayerNetwork(conf, seed=3).init()
+    state = init_train_state(net)
+    d = str(tmp_path / "ckpt")
+    ckpt.save(d, state.params, state.updater, conf=conf, step=42,
+              data_cursor={"epoch": 1, "batch": 7})
+    params, updater, meta = ckpt.load(d, like_params=state.params,
+                                      like_updater=state.updater)
+    assert meta["step"] == 42
+    assert meta["data_cursor"]["batch"] == 7
+    for a, b in zip(jax.tree_util.tree_leaves(state.params),
+                    jax.tree_util.tree_leaves(params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    conf2 = ckpt.load_conf(d)
+    assert conf2.n_layers == conf.n_layers
+
+
+def test_checkpoint_async(tmp_path):
+    conf = _mlp_conf()
+    net = MultiLayerNetwork(conf, seed=3).init()
+    d = str(tmp_path / "ck2")
+    t = ckpt.save_async(d, net.params, conf=conf, step=1)
+    t.join(timeout=30)
+    params, _, meta = ckpt.load(d, like_params=net.params)
+    assert meta["step"] == 1
+
+
+def test_local_runner_retains_all_results_per_job():
+    """Results are keyed per job, not per worker: 9 jobs / 1 worker."""
+    runner = LocalRunner(lambda w: w, lambda rs: rs, n_workers=1)
+    out = runner.run(range(1, 10))
+    assert sorted(out) == list(range(1, 10))
+
+
+def test_local_runner_poisoned_job_terminates():
+    def perform(w):
+        if w == 3:
+            raise ValueError("poison")
+        return w
+
+    runner = LocalRunner(perform, lambda rs: rs, n_workers=2)
+    out = runner.run(range(6))
+    assert 3 not in out and len(out) == 5
+    assert runner.tracker.count("jobs_failed") >= 1
