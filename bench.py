@@ -27,8 +27,7 @@ def main() -> None:
 
     from deeplearning4j_tpu.models.zoo import lenet5
     from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
-    from deeplearning4j_tpu.parallel.data_parallel import (
-        DataParallelTrainer, init_train_state, make_dp_train_step)
+    from deeplearning4j_tpu.parallel.data_parallel import DataParallelTrainer
     from deeplearning4j_tpu.parallel.mesh import make_mesh, shard_batch
 
     n_dev = len(jax.devices())

@@ -9,3 +9,9 @@ paragraph_vectors — doc embeddings on top of word2vec
 
 from deeplearning4j_tpu.models.zoo import (lenet5, mlp, char_lstm,
                                            vgg_cifar10)
+from deeplearning4j_tpu.models.embeddings import (InMemoryLookupTable,
+                                                  read_word_vectors,
+                                                  write_word_vectors)
+from deeplearning4j_tpu.models.word2vec import Word2Vec
+from deeplearning4j_tpu.models.glove import Glove
+from deeplearning4j_tpu.models.paragraph_vectors import ParagraphVectors

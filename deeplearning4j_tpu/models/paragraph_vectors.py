@@ -1,0 +1,131 @@
+"""ParagraphVectors (doc2vec) — document embeddings on the word2vec machinery.
+
+Parity: reference `models/paragraphvectors/ParagraphVectors.java:55-498`
+(`extends Word2Vec`: label tokens are trained alongside words — PV-DBOW/
+PV-DM style).  Here: doc vectors live in their own table; each skip-gram
+pair additionally trains the pair's document vector against the context
+word's HS path / negative samples (distributed-memory flavor with the doc
+vector standing in as an extra context window member).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.models.word2vec import Word2Vec, _w2v_step
+from deeplearning4j_tpu.text.vocab import Huffman
+
+
+class ParagraphVectors(Word2Vec):
+    def __init__(self, *args, labels: Optional[Sequence[str]] = None,
+                 doc_epochs: Optional[int] = None, **kw):
+        super().__init__(*args, **kw)
+        self.labels: List[str] = list(labels) if labels else []
+        self.doc_vectors: Optional[jnp.ndarray] = None
+        # doc vectors see far fewer pairs than words do (one per token vs
+        # one per window slot), so the doc phase runs longer by default
+        self.doc_epochs = doc_epochs if doc_epochs else 5 * self.epochs
+
+    def fit(self, sentences=None, labels=None) -> "ParagraphVectors":
+        sentences = list(sentences if sentences is not None
+                         else self.sentences)
+        if labels is not None:
+            self.labels = list(labels)
+        if not self.labels:
+            self.labels = [f"DOC_{i}" for i in range(len(sentences))]
+
+        # 1) word tables via plain word2vec
+        super().fit(sentences)
+
+        # 2) doc vectors trained against each doc's words (PV-DBOW: the doc
+        # vector predicts each word in the doc through the HS tree /
+        # negatives, reference's label-token training)
+        token_lists = [self.tokenize(s) if isinstance(s, str) else list(s)
+                       for s in sentences]
+        n_docs = len(sentences)
+        key = jax.random.PRNGKey(self.seed + 1)
+        doc = (jax.random.uniform(key, (n_docs, self.vector_length))
+               - 0.5) / self.vector_length
+
+        codes_all, points_all, mask_all = Huffman.padded_arrays(self.cache)
+        if not self.use_hs:
+            mask_all = np.zeros_like(mask_all)
+        neg_logits = jnp.log(jnp.asarray(
+            self.table.unigram_table_probs()) + 1e-30)
+
+        doc_ids, word_ids = [], []
+        for d, toks in enumerate(token_lists):
+            for t in toks:
+                i = self.cache.index_of(t)
+                if i >= 0:
+                    doc_ids.append(d)
+                    word_ids.append(i)
+        if not doc_ids:
+            self.doc_vectors = doc
+            return self
+        doc_ids = np.asarray(doc_ids, np.int32)
+        word_ids = np.asarray(word_ids, np.int32)
+
+        # doc table trains in syn0's slot; the shared HS/negative tables
+        # continue to co-train, as the reference's label tokens do
+        tables = {"syn0": doc,
+                  "syn1": jnp.asarray(self.table.syn1, jnp.float32),
+                  "syn1neg": jnp.asarray(self.table.syn1neg, jnp.float32)
+                  if self.table.syn1neg is not None else
+                  jnp.zeros((self.cache.num_words(), self.vector_length),
+                            jnp.float32)}
+        B = min(self.batch_size, len(doc_ids))
+        rng = np.random.RandomState(self.seed)
+        steps_total = max(1, self.doc_epochs * ((len(doc_ids) - 1) // B + 1))
+        step_i = 0
+        for _ in range(self.doc_epochs):
+            perm = rng.permutation(len(doc_ids))
+            for s in range(0, len(doc_ids), B):
+                idx = perm[s:s + B]
+                if len(idx) < B:
+                    idx = np.resize(idx, B)
+                d_np, w_np = doc_ids[idx], word_ids[idx]
+                alpha = max(self.min_alpha,
+                            self.alpha * (1 - step_i / steps_total))
+                key, sub = jax.random.split(key)
+                tables, _ = _w2v_step(
+                    tables, jnp.asarray(d_np), jnp.asarray(w_np),
+                    jnp.asarray(codes_all[w_np]),
+                    jnp.asarray(points_all[w_np]),
+                    jnp.asarray(mask_all[w_np]),
+                    neg_logits, sub, jnp.asarray(alpha, jnp.float32),
+                    self.negative)
+                step_i += 1
+        self.doc_vectors = tables["syn0"]
+        return self
+
+    # -- doc query surface --------------------------------------------------
+    def doc_vector(self, label: str) -> Optional[np.ndarray]:
+        if label not in self.labels:
+            return None
+        return np.asarray(self.doc_vectors[self.labels.index(label)])
+
+    def doc_similarity(self, l1: str, l2: str) -> float:
+        a, b = self.doc_vector(l1), self.doc_vector(l2)
+        if a is None or b is None:
+            return float("nan")
+        na, nb = np.linalg.norm(a), np.linalg.norm(b)
+        if na == 0 or nb == 0:
+            return 0.0
+        return float(a @ b / (na * nb))
+
+    def nearest_docs(self, label: str, top: int = 5):
+        v = self.doc_vector(label)
+        if v is None:
+            return []
+        dv = np.asarray(self.doc_vectors)
+        sims = dv @ v / (np.linalg.norm(dv, axis=1)
+                         * (np.linalg.norm(v) + 1e-12) + 1e-12)
+        order = np.argsort(-sims)
+        return [(self.labels[i], float(sims[i])) for i in order
+                if self.labels[i] != label][:top]

@@ -1,0 +1,217 @@
+"""Word2Vec — skip-gram with hierarchical softmax + negative sampling.
+
+Parity: reference `models/word2vec/Word2Vec.java:59-643` (vocab build ->
+Huffman codes -> threaded skip-gram over sentences, subsampling, linear
+alpha decay) with the inner math of
+`InMemoryLookupTable.iterateSample(w1,w2,nextRandom,alpha)`
+(InMemoryLookupTable.java:198-260: HS dot/expTable/axpy + negative-sampling
+loop over syn1Neg; lock-free HogWild updates).
+
+TPU-native design (SURVEY §7 hard-part 3): the scalar HogWild loop becomes
+a BATCHED dense objective compiled once —
+  * skip-gram pairs are built host-side per sentence batch (dynamic window
+    shrink `b = rand % window` exactly as the reference),
+  * hierarchical softmax uses padded [B, L] code/point arrays gathered from
+    syn1: loss = -sum mask * log sigmoid((1-2*code) * <syn0[w], syn1[pt]>),
+  * negative sampling draws K ids per pair from the unigram^0.75 table on
+    device (jax.random.categorical) and applies the standard logistic loss,
+  * updates are jax.grad scatter-adds (XLA turns the embedding gradients
+    into efficient scatters) with SGD at the per-batch alpha — synchronous
+    minibatch SGD replaces async HogWild; convergence is validated on
+    similarity/analogy behavior, not bitwise (per SURVEY).
+"""
+
+from __future__ import annotations
+
+import logging
+from functools import partial
+from typing import Iterable, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.models.embeddings import InMemoryLookupTable
+from deeplearning4j_tpu.text.stopwords import STOP_WORDS
+from deeplearning4j_tpu.text.tokenization import DefaultTokenizerFactory
+from deeplearning4j_tpu.text.vocab import Huffman, VocabCache
+
+log = logging.getLogger("deeplearning4j_tpu")
+
+
+@partial(jax.jit, static_argnames=("negative",), donate_argnums=(0,))
+def _w2v_step(tables, centers, contexts, codes, points, code_mask,
+              neg_logits, key, alpha, negative: int):
+    """One batched skip-gram SGD step; returns (tables, loss)."""
+
+    def loss_fn(tb):
+        syn0, syn1, syn1neg = tb["syn0"], tb["syn1"], tb["syn1neg"]
+        v_in = syn0[centers]                                  # [B, D]
+        total = jnp.asarray(0.0, jnp.float32)
+        # hierarchical softmax over the context word's Huffman path
+        nodes = syn1[points]                                  # [B, L, D]
+        dots = jnp.einsum("bd,bld->bl", v_in, nodes)
+        sign = 1.0 - 2.0 * codes                              # code 0 -> +1
+        hs = -jax.nn.log_sigmoid(sign * dots) * code_mask
+        total = total + jnp.sum(hs)
+        if negative > 0:
+            B = centers.shape[0]
+            neg = jax.random.categorical(key, neg_logits,
+                                         shape=(B, negative))
+            pos_d = jnp.einsum("bd,bd->b", v_in, syn1neg[contexts])
+            neg_d = jnp.einsum("bd,bkd->bk", v_in, syn1neg[neg])
+            total = total - jnp.sum(jax.nn.log_sigmoid(pos_d))
+            total = total + jnp.sum(-jax.nn.log_sigmoid(-neg_d))
+        # SUM, not mean: each pair must contribute a full-strength update to
+        # its embedding rows, matching the reference's per-sample SGD
+        # (iterateSample applies alpha per pair, not alpha/batch)
+        return total
+
+    loss, grads = jax.value_and_grad(loss_fn)(tables)
+    tables = jax.tree_util.tree_map(
+        lambda t, g: t - alpha * g, tables, grads)
+    return tables, loss
+
+
+class Word2Vec:
+    """Reference-parity configuration surface: vector length, window,
+    min word frequency, subsampling, negative sampling, alpha decay."""
+
+    def __init__(self, sentences=None, tokenizer_factory=None,
+                 vector_length: int = 100, window: int = 5,
+                 min_word_frequency: int = 5, alpha: float = 0.025,
+                 min_alpha: float = 1e-4, negative: int = 5,
+                 use_hierarchical_softmax: bool = True,
+                 sample: float = 0.0, batch_size: int = 512,
+                 epochs: int = 1, seed: int = 123,
+                 stop_words=(), use_adagrad: bool = False):
+        self.sentences = sentences
+        self.tokenizer = tokenizer_factory or DefaultTokenizerFactory()
+        self.vector_length = vector_length
+        self.window = window
+        self.min_word_frequency = min_word_frequency
+        self.alpha = alpha
+        self.min_alpha = min_alpha
+        self.negative = negative
+        self.use_hs = use_hierarchical_softmax
+        self.sample = sample
+        self.batch_size = batch_size
+        self.epochs = epochs
+        self.seed = seed
+        self.stop_words = set(stop_words)
+        self.cache: Optional[VocabCache] = None
+        self.table: Optional[InMemoryLookupTable] = None
+        self._rng = np.random.RandomState(seed)
+
+    # -- vocab -------------------------------------------------------------
+    def tokenize(self, sentence: str) -> List[str]:
+        return [t for t in self.tokenizer.tokenize(sentence)
+                if t and t not in self.stop_words]
+
+    def build_vocab(self, token_lists: Sequence[Sequence[str]]) -> None:
+        self.cache = VocabCache(self.min_word_frequency).fit(token_lists)
+        Huffman.build(self.cache)
+        self.table = InMemoryLookupTable(
+            self.cache, self.vector_length, self.seed,
+            negative=float(self.negative))
+
+    # -- pair generation (host side) --------------------------------------
+    def _pairs(self, token_ids: Sequence[np.ndarray]):
+        """Skip-gram (center, context) pairs with dynamic window shrink
+        (reference `skipGram`: b = rand % window) and frequency
+        subsampling."""
+        counts = self.cache.counts()
+        total = counts.sum()
+        centers, contexts = [], []
+        for ids in token_ids:
+            if self.sample > 0:
+                # word2vec subsampling: keep with prob (sqrt(f/t)+1)*t/f
+                f = counts[ids] / total
+                keep = (np.sqrt(f / self.sample) + 1) * self.sample / f
+                ids = ids[self._rng.rand(len(ids)) < keep]
+            n = len(ids)
+            for i in range(n):
+                b = self._rng.randint(0, self.window)
+                lo, hi = max(0, i - (self.window - b)), \
+                    min(n, i + 1 + (self.window - b))
+                for j in range(lo, hi):
+                    if j != i:
+                        centers.append(ids[i])
+                        contexts.append(ids[j])
+        return np.asarray(centers, np.int32), np.asarray(contexts, np.int32)
+
+    # -- training ----------------------------------------------------------
+    def fit(self, sentences=None) -> "Word2Vec":
+        sentences = sentences if sentences is not None else self.sentences
+        token_lists = [self.tokenize(s) if isinstance(s, str) else list(s)
+                       for s in sentences]
+        if self.cache is None:
+            self.build_vocab(token_lists)
+        ids_per_sentence = [
+            np.asarray([self.cache.index_of(t) for t in toks
+                        if t in self.cache], np.int32)
+            for toks in token_lists]
+
+        codes_all, points_all, mask_all = Huffman.padded_arrays(self.cache)
+        if not self.use_hs:
+            mask_all = np.zeros_like(mask_all)
+        neg_logits = jnp.log(jnp.asarray(
+            self.table.unigram_table_probs()) + 1e-30)
+
+        tables = {
+            "syn0": jnp.asarray(self.table.syn0, jnp.float32),
+            "syn1": jnp.asarray(self.table.syn1, jnp.float32),
+            "syn1neg": (jnp.asarray(self.table.syn1neg, jnp.float32)
+                        if self.table.syn1neg is not None
+                        else jnp.zeros((self.cache.num_words(),
+                                        self.vector_length), jnp.float32)),
+        }
+        key = jax.random.PRNGKey(self.seed)
+
+        centers, contexts = self._pairs(ids_per_sentence)
+        n_pairs = len(centers)
+        if n_pairs == 0:
+            log.warning("word2vec: no training pairs")
+            return self
+        steps_total = max(1, self.epochs * ((n_pairs - 1)
+                                            // self.batch_size + 1))
+        step_i = 0
+        B = self.batch_size
+        for epoch in range(self.epochs):
+            perm = self._rng.permutation(n_pairs)
+            for s in range(0, n_pairs, B):
+                idx = perm[s:s + B]
+                if len(idx) < B:  # pad to static shape for one compilation
+                    idx = np.concatenate(
+                        [idx, perm[:B - len(idx)] if n_pairs >= B
+                         else np.resize(idx, B - len(idx))])
+                c_np, t_np = centers[idx], contexts[idx]
+                # linear alpha decay (Word2Vec.java alpha schedule)
+                alpha = max(self.min_alpha,
+                            self.alpha * (1 - step_i / steps_total))
+                key, sub = jax.random.split(key)
+                tables, loss = _w2v_step(
+                    tables, jnp.asarray(c_np), jnp.asarray(t_np),
+                    jnp.asarray(codes_all[t_np]),
+                    jnp.asarray(points_all[t_np]),
+                    jnp.asarray(mask_all[t_np]),
+                    neg_logits, sub, jnp.asarray(alpha, jnp.float32),
+                    self.negative)
+                step_i += 1
+        self.table.syn0 = tables["syn0"]
+        self.table.syn1 = tables["syn1"]
+        self.table.syn1neg = tables["syn1neg"]
+        return self
+
+    # -- query surface (delegates to the lookup table) ---------------------
+    def vector(self, word):
+        return self.table.vector(word)
+
+    def similarity(self, a, b):
+        return self.table.similarity(a, b)
+
+    def words_nearest(self, word, top=10):
+        return self.table.words_nearest(word, top)
+
+    def analogy(self, a, b, c, top=5):
+        return self.table.analogy(a, b, c, top)

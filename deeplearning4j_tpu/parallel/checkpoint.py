@@ -64,13 +64,16 @@ def save(directory: str, params, updater=None, *, conf=None, step: int = 0,
             with open(os.path.join(tmp, "conf.json"), "w") as f:
                 f.write(conf.to_json())
         if os.path.isdir(directory):
-            # never a window with zero checkpoints on disk: move the old one
-            # aside, swing the new one in, then drop the old
-            old = tempfile.mkdtemp(prefix=".ckpt-old-", dir=parent)
-            os.rmdir(old)
-            os.replace(directory, old)
+            # crash-safe swap: the previous checkpoint moves to the
+            # deterministic '<dir>.bak' (which load() falls back to if a
+            # crash lands between the two renames), then the new one swings
+            # in and the backup is dropped
+            bak = directory + ".bak"
+            if os.path.isdir(bak):
+                shutil.rmtree(bak)
+            os.replace(directory, bak)
             os.replace(tmp, directory)
-            shutil.rmtree(old, ignore_errors=True)
+            shutil.rmtree(bak, ignore_errors=True)
         else:
             os.replace(tmp, directory)
     except BaseException:
@@ -97,7 +100,12 @@ def load(directory: str, like_params=None, like_updater=None
          ) -> Tuple[Any, Any, Dict[str, Any]]:
     """Read a checkpoint.  With `like_*` example pytrees the arrays are
     restored into that exact structure; otherwise a nested dict keyed by
-    tree path is returned.  Returns (params, updater_or_None, meta)."""
+    tree path is returned.  Returns (params, updater_or_None, meta).
+
+    Falls back to '<dir>.bak' when the directory is missing (a crash
+    between save()'s two renames leaves the previous checkpoint there)."""
+    if not os.path.isdir(directory) and os.path.isdir(directory + ".bak"):
+        directory = directory + ".bak"
     with np.load(os.path.join(directory, "arrays.npz")) as z:
         flat = {k: z[k] for k in z.files}
     with open(os.path.join(directory, "meta.json")) as f:

@@ -22,10 +22,9 @@ import threading
 import time
 from dataclasses import dataclass, field
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Any, Callable, Dict, List, Optional
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 DEFAULT_STALE_AFTER_S = 120.0   # MasterActor reaper threshold (:141-171)
-DEFAULT_REAP_EVERY_S = 60.0
 
 
 @dataclass
@@ -55,7 +54,7 @@ class StateTracker:
         self._enabled: Dict[str, bool] = {}
         self._jobs: Dict[str, Job] = {}             # per-worker job slot
         self._unclaimed: "queue.Queue[Job]" = queue.Queue()  # requeued work
-        self._updates: Dict[str, Any] = {}          # worker -> result
+        self._updates: List[Tuple[str, Any]] = []   # (worker, result) log
         self._current = None                        # current model (atomic ref)
         self._counters: Dict[str, float] = {}
         self._batches_so_far = 0
@@ -130,15 +129,18 @@ class StateTracker:
     # -- updates (StateTracker.java:225-231) -------------------------------
     def add_update(self, worker_id: str, result: Any) -> None:
         with self._lock:
-            self._updates[worker_id] = result
+            # an append log, not a worker-keyed map: one worker may finish
+            # several jobs per wave and every result must survive
+            self._updates.append((worker_id, result))
             job = self._jobs.get(worker_id)
             if job is not None:
                 job.pending = False
                 job.result = result
 
-    def updates(self) -> Dict[str, Any]:
+    def updates(self) -> List[Any]:
+        """All results since the last clear, in completion order."""
         with self._lock:
-            return dict(self._updates)
+            return [r for _, r in self._updates]
 
     def clear_updates(self) -> None:
         with self._lock:

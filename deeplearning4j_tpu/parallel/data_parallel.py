@@ -21,8 +21,7 @@ boundary" of the reference (Hazelcast job slots) becomes ICI collectives.
 
 from __future__ import annotations
 
-from functools import partial
-from typing import Iterable, NamedTuple, Optional
+from typing import Iterable, NamedTuple
 
 import jax
 import jax.numpy as jnp
@@ -50,7 +49,11 @@ class TrainState(NamedTuple):
 def init_train_state(net: MultiLayerNetwork) -> TrainState:
     if net.params is None:
         net.init()
-    return TrainState(params=net.params, updater=init_updater(net.params),
+    # copy: train steps donate the state's buffers, and donating the
+    # network's own params would leave net.output()/score() holding
+    # deleted arrays mid-fit on TPU
+    params = jax.tree_util.tree_map(jnp.copy, net.params)
+    return TrainState(params=params, updater=init_updater(params),
                       step=jnp.asarray(0, jnp.int32))
 
 
@@ -90,8 +93,7 @@ def make_dp_train_step(conf: MultiLayerConfiguration, mesh: Mesh,
     return jax.jit(sharded, donate_argnums=(0,))
 
 
-def make_sharded_train_step(conf: MultiLayerConfiguration, mesh: Mesh,
-                            params_example=None):
+def make_sharded_train_step(conf: MultiLayerConfiguration, mesh: Mesh):
     """Compiler-partitioned (pjit-style) training step for meshes with
     tensor-parallel axes: params get `tp` shardings via `param_pspecs`,
     batch is sharded over `dp`, and XLA inserts the collectives (psum for

@@ -1,0 +1,19 @@
+"""Text infrastructure — SURVEY §2 #24.
+
+Parity with the reference's `deeplearning4j-nlp` text layer:
+  sentence_iterator — SentenceIterator/DocumentIterator family
+  tokenization      — Tokenizer/TokenizerFactory + InputHomogenization
+  stopwords         — StopWords list
+  vocab             — VocabCache/VocabWord + Huffman coding
+  windows           — moving-window featurization
+  inverted_index    — corpus store for mini-batched embedding training
+  vectorizers       — BagOfWords / TF-IDF
+"""
+
+from deeplearning4j_tpu.text.sentence_iterator import (
+    CollectionSentenceIterator, FileSentenceIterator, LineSentenceIterator,
+    LabelAwareSentenceIterator)
+from deeplearning4j_tpu.text.tokenization import (DefaultTokenizer,
+                                                  DefaultTokenizerFactory,
+                                                  input_homogenization)
+from deeplearning4j_tpu.text.vocab import Huffman, VocabCache, VocabWord

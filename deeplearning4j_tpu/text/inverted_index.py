@@ -1,0 +1,75 @@
+"""Inverted index — the corpus store for embedding mini-batching.
+
+Parity: reference `text/invertedindex/LuceneInvertedIndex.java` — an
+on-disk document index whose roles in the pipeline are (a) doc storage for
+mini-batch sampling during word2vec training, (b) posting lists for
+word -> documents, (c) doc count statistics for TF-IDF.  Lucene is
+replaced by a plain in-memory structure with optional JSON spill.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import random
+from typing import Dict, Iterable, List, Optional, Sequence
+
+
+class InvertedIndex:
+    def __init__(self):
+        self._docs: List[List[str]] = []
+        self._labels: List[Optional[str]] = []
+        self._postings: Dict[str, List[int]] = {}
+
+    # -- building ----------------------------------------------------------
+    def add_doc(self, tokens: Sequence[str],
+                label: Optional[str] = None) -> int:
+        doc_id = len(self._docs)
+        toks = list(tokens)
+        self._docs.append(toks)
+        self._labels.append(label)
+        for t in set(toks):
+            self._postings.setdefault(t, []).append(doc_id)
+        return doc_id
+
+    # -- queries -----------------------------------------------------------
+    def document(self, doc_id: int) -> List[str]:
+        return self._docs[doc_id]
+
+    def label(self, doc_id: int) -> Optional[str]:
+        return self._labels[doc_id]
+
+    def documents_containing(self, word: str) -> List[int]:
+        return list(self._postings.get(word, []))
+
+    def doc_frequency(self, word: str) -> int:
+        return len(self._postings.get(word, []))
+
+    def num_documents(self) -> int:
+        return len(self._docs)
+
+    def all_docs(self) -> Iterable[List[str]]:
+        return iter(self._docs)
+
+    def sample_docs(self, batch: int, rng: Optional[random.Random] = None
+                    ) -> List[List[str]]:
+        """Random doc mini-batch (the w2v batching role)."""
+        rng = rng or random
+        n = self.num_documents()
+        if n == 0:
+            return []
+        return [self._docs[rng.randrange(n)] for _ in range(batch)]
+
+    # -- persistence -------------------------------------------------------
+    def save(self, path: str) -> None:
+        with open(path, "w") as f:
+            json.dump({"docs": self._docs, "labels": self._labels}, f)
+
+    @classmethod
+    def load(cls, path: str) -> "InvertedIndex":
+        idx = cls()
+        with open(path) as f:
+            data = json.load(f)
+        for toks, label in zip(data["docs"], data["labels"]):
+            idx.add_doc(toks, label)
+        return idx

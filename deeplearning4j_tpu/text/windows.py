@@ -1,0 +1,60 @@
+"""Moving-window featurization.
+
+Parity: reference `text/movingwindow/{Windows,WindowConverter,WordConverter}`
+— fixed-size word windows with <s>/</s> padding, converted to stacked
+word-vector features for window-classification models (the viterbi-decoded
+sequence labelers), and `util/MovingWindowMatrix`.
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence
+
+import numpy as np
+
+BEGIN = "<s>"
+END = "</s>"
+
+
+class Window:
+    def __init__(self, words: Sequence[str], focus: int, label: str = "NONE"):
+        self.words = list(words)
+        self.focus = focus
+        self.label = label
+
+    def focus_word(self) -> str:
+        return self.words[self.focus]
+
+    def __repr__(self):
+        return f"Window({self.words}, focus={self.focus_word()!r})"
+
+
+def windows(tokens: Sequence[str], window_size: int = 5) -> List[Window]:
+    """All windows over a token list, padded at the edges
+    (`Windows.java` contract; window_size must be odd-centered)."""
+    half = window_size // 2
+    padded = [BEGIN] * half + list(tokens) + [END] * half
+    out = []
+    for i in range(len(tokens)):
+        out.append(Window(padded[i:i + window_size], half))
+    return out
+
+
+def window_features(window: Window, lookup, vec_len: int) -> np.ndarray:
+    """Stack word vectors of a window into one feature row
+    (`WindowConverter.asExampleMatrix` parity); unknown words -> zeros."""
+    rows = []
+    for w in window.words:
+        v = lookup(w)
+        rows.append(np.zeros(vec_len, np.float32) if v is None
+                    else np.asarray(v, np.float32))
+    return np.concatenate(rows)
+
+
+def moving_window_matrix(x: np.ndarray, window: int, stride: int = 1
+                         ) -> np.ndarray:
+    """Rolling windows over a 1-d/2-d array's rows
+    (`util/MovingWindowMatrix.java`)."""
+    x = np.asarray(x)
+    n = (len(x) - window) // stride + 1
+    return np.stack([x[i * stride:i * stride + window] for i in range(n)])

@@ -1,0 +1,85 @@
+"""Embedding model tests — word2vec/glove/paragraph vectors.
+
+Per SURVEY §7 hard-part 3: convergence is validated on similarity behavior
+(words that share contexts end up close), not bitwise vs the reference's
+HogWild loop.
+"""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.models.embeddings import (read_word_vectors,
+                                                  write_word_vectors)
+from deeplearning4j_tpu.models.glove import Glove
+from deeplearning4j_tpu.models.paragraph_vectors import ParagraphVectors
+from deeplearning4j_tpu.models.word2vec import Word2Vec
+
+
+def _corpus(n=200, seed=0):
+    """Two topic clusters: {cat,dog,pet} vs {car,truck,road} — words inside
+    a cluster co-occur, across clusters they never do."""
+    rng = np.random.RandomState(seed)
+    animals = ["cat", "dog", "pet", "fur", "paw"]
+    vehicles = ["car", "truck", "road", "wheel", "engine"]
+    out = []
+    for _ in range(n):
+        pool = animals if rng.rand() < 0.5 else vehicles
+        out.append(" ".join(rng.choice(pool, size=8)))
+    return out
+
+
+def test_word2vec_trains_and_clusters():
+    w2v = Word2Vec(vector_length=24, window=4, min_word_frequency=1,
+                   negative=4, epochs=4, batch_size=256, seed=1)
+    w2v.fit(_corpus())
+    assert w2v.vector("cat").shape == (24,)
+    same = w2v.similarity("cat", "dog")
+    cross = w2v.similarity("cat", "truck")
+    assert same > cross, (same, cross)
+    near = [w for w, _ in w2v.words_nearest("cat", top=4)]
+    assert any(w in ("dog", "pet", "fur", "paw") for w in near)
+
+
+def test_word2vec_hs_only():
+    w2v = Word2Vec(vector_length=16, window=3, min_word_frequency=1,
+                   negative=0, use_hierarchical_softmax=True, epochs=3,
+                   batch_size=128, seed=2)
+    w2v.fit(_corpus(120))
+    assert w2v.similarity("car", "truck") > w2v.similarity("car", "dog")
+
+
+def test_word2vec_serialization_roundtrip(tmp_path):
+    w2v = Word2Vec(vector_length=8, min_word_frequency=1, epochs=1,
+                   batch_size=64, seed=3)
+    w2v.fit(_corpus(40))
+    path = str(tmp_path / "vectors.txt")
+    write_word_vectors(w2v.table, path)
+    table = read_word_vectors(path)
+    v1, v2 = w2v.vector("cat"), table.vector("cat")
+    np.testing.assert_allclose(v1, v2, rtol=1e-4, atol=1e-5)
+
+
+def test_glove_trains_and_clusters():
+    g = Glove(vector_length=16, window=5, min_word_frequency=1,
+              epochs=20, seed=1)
+    g.fit(_corpus(150))
+    assert g.similarity("cat", "dog") > g.similarity("cat", "engine")
+
+
+def test_paragraph_vectors():
+    docs = ["cat dog pet fur paw cat dog", "car truck road wheel engine",
+            "dog pet paw fur cat pet", "truck car engine wheel road"]
+    pv = ParagraphVectors(vector_length=16, window=3, min_word_frequency=1,
+                          negative=3, epochs=8, batch_size=64, seed=4,
+                          labels=["an1", "ve1", "an2", "ve2"])
+    pv.fit(docs)
+    assert pv.doc_vector("an1").shape == (16,)
+    assert pv.doc_similarity("an1", "an2") > pv.doc_similarity("an1", "ve1")
+
+
+def test_word2vec_analogy_api():
+    w2v = Word2Vec(vector_length=8, min_word_frequency=1, epochs=1,
+                   batch_size=32, seed=5)
+    w2v.fit(_corpus(30))
+    out = w2v.analogy("cat", "dog", "car", top=3)
+    assert isinstance(out, list)  # API shape; semantics need a real corpus

@@ -13,19 +13,19 @@ from deeplearning4j_tpu.nn.weights import WeightInit, init_weights
 
 def test_activations_match_closed_forms():
     x = jnp.linspace(-3, 3, 13)
-    np.testing.assert_allclose(activate("sigmoid", x), 1 / (1 + np.exp(-np.asarray(x))), rtol=1e-4, atol=1e-4)
-    np.testing.assert_allclose(activate("tanh", x), np.tanh(np.asarray(x)), rtol=1e-4, atol=1e-4)
-    np.testing.assert_allclose(activate("relu", x), np.maximum(0, np.asarray(x)), rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(activate("sigmoid", x), 1 / (1 + np.exp(-np.asarray(x))), rtol=1e-5, atol=5e-5)
+    np.testing.assert_allclose(activate("tanh", x), np.tanh(np.asarray(x)), rtol=1e-5, atol=5e-5)
+    np.testing.assert_allclose(activate("relu", x), np.maximum(0, np.asarray(x)), rtol=1e-6, atol=0)
     sm = activate("softmax", jnp.ones((2, 4)))
-    np.testing.assert_allclose(sm, 0.25 * np.ones((2, 4)), rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(sm, 0.25 * np.ones((2, 4)), rtol=1e-6, atol=1e-7)
 
 
 def test_activation_derivatives_autodiff():
     x = jnp.linspace(-2, 2, 9)
     s = np.asarray(activate("sigmoid", x))
-    np.testing.assert_allclose(activation_derivative("sigmoid", x), s * (1 - s), rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(activation_derivative("sigmoid", x), s * (1 - s), rtol=1e-5, atol=1e-4)
     t = np.tanh(np.asarray(x))
-    np.testing.assert_allclose(activation_derivative("tanh", x), 1 - t * t, rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(activation_derivative("tanh", x), 1 - t * t, rtol=1e-5, atol=1e-4)
 
 
 def test_unknown_activation_raises():

@@ -1,0 +1,113 @@
+"""Text infrastructure tests (SURVEY §4: tokenizer/vocab/vectorizer parity)."""
+
+import numpy as np
+
+from deeplearning4j_tpu.text.inverted_index import InvertedIndex
+from deeplearning4j_tpu.text.sentence_iterator import (
+    CollectionSentenceIterator, LabelAwareSentenceIterator,
+    LineSentenceIterator)
+from deeplearning4j_tpu.text.stopwords import is_stop_word
+from deeplearning4j_tpu.text.tokenization import (DefaultTokenizerFactory,
+                                                  NGramTokenizerFactory,
+                                                  input_homogenization)
+from deeplearning4j_tpu.text.vectorizers import (BagOfWordsVectorizer,
+                                                 TfidfVectorizer)
+from deeplearning4j_tpu.text.vocab import Huffman, VocabCache
+from deeplearning4j_tpu.text.windows import moving_window_matrix, windows
+
+
+def test_tokenizer_and_homogenization():
+    tf = DefaultTokenizerFactory(preprocessor=input_homogenization)
+    toks = tf.tokenize("Hello, World!  FOO-bar")
+    assert toks == ["hello", "world", "foobar"]
+    t = tf.create("a b c")
+    assert t.count_tokens() == 3
+    assert t.next_token() == "a" and t.has_more_tokens()
+
+
+def test_ngram_tokenizer():
+    tf = NGramTokenizerFactory(1, 2)
+    toks = tf.tokenize("a b c")
+    assert "a b" in toks and "b c" in toks and "a" in toks
+
+
+def test_sentence_iterators(tmp_path):
+    it = CollectionSentenceIterator(["one", "two"])
+    assert list(it) == ["one", "two"]
+    assert list(it) == ["one", "two"]  # reset works
+
+    p = tmp_path / "s.txt"
+    p.write_text("l1\nl2\nl3\n")
+    li = LineSentenceIterator(str(p))
+    assert list(li) == ["l1", "l2", "l3"]
+
+    la = LabelAwareSentenceIterator(["x", "y"], ["A", "B"])
+    la.reset()
+    la.next_sentence()
+    assert la.current_label() == "A"
+
+
+def test_stopwords():
+    assert is_stop_word("the") and not is_stop_word("tensor")
+
+
+def test_vocab_and_huffman():
+    cache = VocabCache(min_word_frequency=1).fit(
+        [["a", "a", "a", "b", "b", "c"]])
+    assert cache.num_words() == 3
+    assert cache.word_at_index(0) == "a"  # most frequent first
+    Huffman.build(cache)
+    # Kraft equality for a complete prefix code: sum 2^-len == 1
+    total = sum(2.0 ** -len(cache.word_for(w).codes) for w in cache.words())
+    assert abs(total - 1.0) < 1e-9
+    # most frequent word gets the shortest code
+    lens = [len(cache.word_for(w).codes) for w in cache.words()]
+    assert lens[0] == min(lens)
+    codes, points, mask = Huffman.padded_arrays(cache)
+    assert codes.shape == points.shape == mask.shape
+    assert mask.sum() == sum(lens)
+    # inner-node ids are valid syn1 rows
+    assert points.max() < cache.num_words() - 1
+
+
+def test_inverted_index():
+    idx = InvertedIndex()
+    idx.add_doc(["the", "cat"], label="pet")
+    idx.add_doc(["the", "dog"])
+    assert idx.num_documents() == 2
+    assert idx.doc_frequency("the") == 2
+    assert idx.documents_containing("cat") == [0]
+    assert idx.label(0) == "pet"
+
+
+def test_bow_and_tfidf():
+    docs = ["cat sat mat", "dog sat log", "cat cat dog"]
+    bow = BagOfWordsVectorizer(min_word_frequency=1).fit(docs)
+    v = bow.transform("cat cat dog")
+    assert v[bow.cache.index_of("cat")] == 2.0
+    assert v[bow.cache.index_of("dog")] == 1.0
+
+    tfidf = TfidfVectorizer(min_word_frequency=1).fit(docs)
+    v2 = tfidf.transform("cat sat")
+    # 'sat' appears in 2/3 docs, 'cat' in 2/3; both positive
+    assert v2[tfidf.cache.index_of("cat")] > 0
+    # rare words weigh more than common ones at equal tf
+    docs2 = ["x common", "y common", "z common", "rare common"]
+    tf2 = TfidfVectorizer(min_word_frequency=1).fit(docs2)
+    r = tf2.transform("rare common")
+    assert r[tf2.cache.index_of("rare")] > r[tf2.cache.index_of("common")]
+
+    ds = BagOfWordsVectorizer(min_word_frequency=1).fit(
+        docs, labels=["a", "b", "a"]).vectorize("cat sat", "a")
+    assert ds.labels.shape == (1, 2)
+
+
+def test_windows():
+    ws = windows(["a", "b", "c"], window_size=3)
+    assert len(ws) == 3
+    assert ws[0].words == ["<s>", "a", "b"] and ws[0].focus_word() == "a"
+    assert ws[2].words == ["b", "c", "</s>"]
+
+    m = moving_window_matrix(np.arange(5), 3)
+    assert m.shape == (3, 3)
+    np.testing.assert_array_equal(m[0], [0, 1, 2])
