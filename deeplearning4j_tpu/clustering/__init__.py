@@ -1,0 +1,25 @@
+"""Clustering & spatial geometry toolkit.
+
+Parity: reference `clustering/` (36 files / 5,108 LoC) — `KMeansClustering`
+on the `BaseClusteringAlgorithm` strategy framework, cluster model classes,
+and the spatial trees (`kdtree/KDTree.java`, `vptree/VPTree.java`,
+`quadtree/QuadTree.java`, `sptree/SpTree.java`) that back Barnes-Hut t-SNE
+and the UI nearest-neighbors endpoints.
+
+TPU-native split: k-means distance/assignment math runs as one jitted XLA
+program (MXU matmul for pairwise distances); the trees are host-side index
+structures (pointer-chasing recursion has no TPU win) built over numpy
+arrays.
+"""
+
+from deeplearning4j_tpu.clustering.cluster import Cluster, ClusterSet, Point
+from deeplearning4j_tpu.clustering.kmeans import KMeansClustering
+from deeplearning4j_tpu.clustering.kdtree import KDTree
+from deeplearning4j_tpu.clustering.vptree import VPTree
+from deeplearning4j_tpu.clustering.quadtree import QuadTree
+from deeplearning4j_tpu.clustering.sptree import SpTree
+
+__all__ = [
+    "Cluster", "ClusterSet", "Point", "KMeansClustering", "KDTree",
+    "VPTree", "QuadTree", "SpTree",
+]

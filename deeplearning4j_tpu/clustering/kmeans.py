@@ -1,0 +1,108 @@
+"""K-means clustering as one jitted XLA program.
+
+Parity: reference `clustering/kmeans/KMeansClustering.java` (57 LoC facade)
+on `clustering/algorithm/BaseClusteringAlgorithm.java` — iterate
+{assign points to nearest center, recompute centers} under a pluggable
+termination strategy (fixed iteration count or distance-variation
+convergence).
+
+TPU-native design: pairwise squared distances via one MXU matmul
+(|x|^2 - 2 x.c^T + |c|^2), assignment via argmin, center update via
+segment-sum — the whole Lloyd iteration is a `lax.while_loop` body inside a
+single jit, seeded by k-means++ D^2-weighted sampling.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.clustering.cluster import Cluster, ClusterSet, Point
+from deeplearning4j_tpu.nd.ops import pairwise_sq_dists as _pairwise_sq_dists
+
+
+@partial(jax.jit, static_argnums=(2, 3))
+def _lloyd(x, init_centers, max_iters: int, tol: float):
+    """Full Lloyd loop under jit: while (moved > tol and iters < max)."""
+
+    def update(centers):
+        d = _pairwise_sq_dists(x, centers)
+        assign = jnp.argmin(d, axis=1)
+        one_hot = jax.nn.one_hot(assign, centers.shape[0], dtype=x.dtype)
+        counts = jnp.sum(one_hot, axis=0)
+        sums = one_hot.T @ x
+        new = jnp.where(counts[:, None] > 0,
+                        sums / jnp.maximum(counts[:, None], 1.0),
+                        centers)  # empty cluster keeps its center
+        return new, assign
+
+    def cond(carry):
+        centers, _, moved, it = carry
+        return jnp.logical_and(moved > tol, it < max_iters)
+
+    def body(carry):
+        centers, _, _, it = carry
+        new, assign = update(centers)
+        moved = jnp.max(jnp.linalg.norm(new - centers, axis=1))
+        return new, assign, moved, it + 1
+
+    n = x.shape[0]
+    init_assign = jnp.zeros((n,), jnp.int32)
+    centers, assign, moved, iters = jax.lax.while_loop(
+        cond, body, (init_centers, init_assign, jnp.inf, 0))
+    # final assignment against the converged centers
+    _, assign = update(centers)
+    return centers, assign, iters
+
+
+class KMeansClustering:
+    """`KMeansClustering.setup(k, maxIters, distanceFn)` parity facade."""
+
+    def __init__(self, k: int, max_iterations: int = 100, tol: float = 1e-4,
+                 seed: int = 0):
+        self.k = k
+        self.max_iterations = max_iterations
+        self.tol = tol
+        self.seed = seed
+
+    def _kmeanspp_seed(self, x: np.ndarray,
+                       rng: np.random.RandomState) -> np.ndarray:
+        """k-means++ D^2-weighted seeding (host side; k draws over n)."""
+        centers = [x[rng.randint(len(x))]]
+        d2 = ((x - centers[0]) ** 2).sum(1)
+        for _ in range(1, self.k):
+            total = d2.sum()
+            if total <= 0:  # all remaining points coincide with a center
+                centers.append(x[rng.randint(len(x))])
+                continue
+            i = int(rng.choice(len(x), p=d2 / total))
+            centers.append(x[i])
+            d2 = np.minimum(d2, ((x - x[i]) ** 2).sum(1))
+        return np.stack(centers)
+
+    def apply_to(self, points) -> ClusterSet:
+        """Cluster a list of Points or an (n,d) matrix → ClusterSet."""
+        if isinstance(points, (np.ndarray, jnp.ndarray)):
+            pts = Point.to_points(np.asarray(points))
+        else:
+            pts = list(points)
+        x = np.stack([p.array for p in pts]).astype(np.float32)
+        if len(pts) < self.k:
+            raise ValueError(f"need >= k={self.k} points, got {len(pts)}")
+
+        rng = np.random.RandomState(self.seed)
+        init = self._kmeanspp_seed(x, rng)
+        centers, assign, _ = _lloyd(jnp.asarray(x), jnp.asarray(init),
+                                    self.max_iterations, self.tol)
+        centers = np.asarray(centers)
+        assign = np.asarray(assign)
+
+        clusters = [Cluster(id=i, center=centers[i]) for i in range(self.k)]
+        cs = ClusterSet(clusters=clusters)
+        for p, a in zip(pts, assign):
+            clusters[int(a)].points.append(p)
+            cs.assignments[p.id] = int(a)
+        return cs

@@ -103,3 +103,11 @@ register_activation("hardtanh", lambda x: jnp.clip(x, -1.0, 1.0))
 register_activation("exp", jnp.exp)
 register_activation("elu", jax.nn.elu)
 register_activation("gelu", jax.nn.gelu)
+
+
+def pairwise_sq_dists(x: jnp.ndarray, y: jnp.ndarray) -> jnp.ndarray:
+    """(n,d),(m,d) -> (n,m) squared euclidean distances via one MXU matmul
+    (|x|^2 - 2 x.y^T + |y|^2), clamped against cancellation negatives."""
+    xx = jnp.sum(x * x, axis=1, keepdims=True)
+    yy = jnp.sum(y * y, axis=1)
+    return jnp.maximum(xx - 2.0 * (x @ y.T) + yy[None, :], 0.0)

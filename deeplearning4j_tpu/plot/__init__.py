@@ -1,0 +1,12 @@
+"""Visualization: t-SNE embeddings and network plotting.
+
+Parity: reference `plot/` (8 files / 2,365 LoC) — `Tsne.java:49` (exact
+t-SNE), `BarnesHutTsne.java:62` (theta-approximate t-SNE over SpTree),
+`NeuralNetPlotter` / `FilterRenderer` (weight visualization), and the
+render iteration listeners.
+"""
+
+from deeplearning4j_tpu.plot.tsne import Tsne
+from deeplearning4j_tpu.plot.barnes_hut_tsne import BarnesHutTsne
+
+__all__ = ["Tsne", "BarnesHutTsne"]

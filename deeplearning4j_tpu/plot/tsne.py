@@ -1,0 +1,147 @@
+"""Exact t-SNE as jitted XLA programs.
+
+Parity: reference `plot/Tsne.java:49-530` — `hBeta` + per-point perplexity
+binary search (:109-170), symmetrized P, then the gains+momentum gradient
+loop (:271-330) with early exaggeration.
+
+TPU-native design: the perplexity search is a vmapped, fixed-trip-count
+`lax.while_loop`-free binary search (50 halvings, matching the reference's
+maxTries), and every gradient iteration is one jitted step over dense
+(n, n) matrices — pairwise affinities ride the MXU via matmuls.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.nd.ops import pairwise_sq_dists
+
+MACHINE_EPSILON = 1e-12
+
+
+def _sq_dists(x: jnp.ndarray) -> jnp.ndarray:
+    return pairwise_sq_dists(x, x)
+
+
+def _h_beta(d_row: jnp.ndarray, beta: jnp.ndarray, i: int):
+    """Entropy H and probabilities for one row at precision beta
+    (`Tsne.hBeta` parity)."""
+    p = jnp.exp(-d_row * beta)
+    p = p.at[i].set(0.0)
+    sum_p = jnp.maximum(jnp.sum(p), MACHINE_EPSILON)
+    h = jnp.log(sum_p) + beta * jnp.sum(d_row * p) / sum_p
+    return h, p / sum_p
+
+
+@partial(jax.jit, static_argnums=(1,))
+def _binary_search_probs(d: jnp.ndarray, perplexity: float):
+    """Per-row binary search for beta hitting log(perplexity); 50 tries
+    (reference :129 maxTries=50)."""
+    n = d.shape[0]
+    log_u = jnp.log(perplexity)
+
+    def per_row(d_row, i):
+        def body(carry, _):
+            beta, bmin, bmax = carry
+            h, _ = _h_beta(d_row, beta, i)
+            diff = h - log_u
+            bmin2 = jnp.where(diff > 0, beta, bmin)
+            bmax2 = jnp.where(diff > 0, bmax, beta)
+            beta2 = jnp.where(
+                diff > 0,
+                jnp.where(jnp.isinf(bmax2), beta * 2.0, (beta + bmax2) / 2.0),
+                jnp.where(jnp.isinf(bmin2), beta / 2.0, (beta + bmin2) / 2.0))
+            return (beta2, bmin2, bmax2), None
+
+        (beta, _, _), _ = jax.lax.scan(
+            body, (jnp.float32(1.0), -jnp.inf, jnp.inf), None, length=50)
+        _, p = _h_beta(d_row, beta, i)
+        return p
+
+    return jax.vmap(per_row)(d, jnp.arange(n))
+
+
+@jax.jit
+def _tsne_grad(y: jnp.ndarray, p: jnp.ndarray):
+    """KL gradient wrt the embedding under the Student-t kernel."""
+    num = 1.0 / (1.0 + _sq_dists(y))
+    num = num * (1.0 - jnp.eye(y.shape[0], dtype=y.dtype))
+    q = jnp.maximum(num / jnp.sum(num), MACHINE_EPSILON)
+    pq = (p - q) * num
+    grad = 4.0 * ((jnp.diag(jnp.sum(pq, axis=1)) - pq) @ y)
+    kl = jnp.sum(p * jnp.log(jnp.maximum(p, MACHINE_EPSILON) / q))
+    return grad, kl
+
+
+@jax.jit
+def _update(y, grad, y_incs, gains, momentum, learning_rate, min_gain):
+    """Gains + momentum update (`Tsne.java:284-305` semantics)."""
+    sign_match = jnp.sign(grad) == jnp.sign(y_incs)
+    gains = jnp.clip(jnp.where(sign_match, gains * 0.8, gains + 0.2),
+                     min_gain, jnp.inf)
+    y_incs = momentum * y_incs - learning_rate * gains * grad
+    y = y + y_incs
+    y = y - jnp.mean(y, axis=0)  # re-center (reference :316)
+    return y, y_incs, gains
+
+
+class Tsne:
+    """Exact t-SNE. Builder-parity knobs from `Tsne.java` Builder."""
+
+    def __init__(self, max_iter: int = 1000, perplexity: float = 30.0,
+                 learning_rate: float = 500.0, momentum: float = 0.5,
+                 final_momentum: float = 0.8, switch_momentum_iter: int = 250,
+                 stop_lying_iter: int = 250, exaggeration: float = 12.0,
+                 min_gain: float = 0.01, n_components: int = 2,
+                 seed: int = 0):
+        self.max_iter = max_iter
+        self.perplexity = perplexity
+        self.learning_rate = learning_rate
+        self.momentum = momentum
+        self.final_momentum = final_momentum
+        self.switch_momentum_iter = switch_momentum_iter
+        self.stop_lying_iter = stop_lying_iter
+        self.exaggeration = exaggeration
+        self.min_gain = min_gain
+        self.n_components = n_components
+        self.seed = seed
+        self.kl_history: list = []
+
+    def compute_p(self, x: np.ndarray) -> jnp.ndarray:
+        """Symmetrized input affinities P (reference `computeGaussianPerplexity`)."""
+        x = jnp.asarray(x, jnp.float32)
+        d = _sq_dists(x)
+        p = _binary_search_probs(d, self.perplexity)
+        p = p + p.T
+        return jnp.maximum(p / jnp.sum(p), MACHINE_EPSILON)
+
+    def calculate(self, x: np.ndarray) -> np.ndarray:
+        """Embed (n, d) → (n, n_components)."""
+        x = np.asarray(x, np.float32)
+        n = x.shape[0]
+        p = self.compute_p(x)
+        key = jax.random.PRNGKey(self.seed)
+        y = jax.random.normal(key, (n, self.n_components)) * 1e-4
+        y_incs = jnp.zeros_like(y)
+        gains = jnp.ones_like(y)
+        self.kl_history = []
+
+        p_lied = p * self.exaggeration
+        for it in range(self.max_iter):
+            p_cur = p_lied if it < self.stop_lying_iter else p
+            mom = (self.momentum if it < self.switch_momentum_iter
+                   else self.final_momentum)
+            grad, _ = _tsne_grad(y, p_cur)
+            y, y_incs, gains = _update(
+                y, grad, y_incs, gains, mom, self.learning_rate,
+                self.min_gain)
+            if it % 100 == 0:
+                # log KL against the true (un-exaggerated) P so entries are
+                # comparable across the lying/plain phases
+                _, kl = _tsne_grad(y, p)
+                self.kl_history.append(float(kl))
+        return np.asarray(y)

@@ -1,0 +1,155 @@
+"""Clustering/geometry tests — reference test parity:
+`clustering/{kdtree,vptree,quadtree,sptree}` tests + kmeans behavior."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.clustering import (
+    KDTree, KMeansClustering, QuadTree, SpTree, VPTree)
+
+
+def _two_blobs(n=60, seed=0):
+    rng = np.random.RandomState(seed)
+    a = rng.randn(n // 2, 3) * 0.2 + np.array([0.0, 0.0, 0.0])
+    b = rng.randn(n // 2, 3) * 0.2 + np.array([5.0, 5.0, 5.0])
+    return np.vstack([a, b]).astype(np.float32)
+
+
+class TestKMeans:
+    def test_two_blobs_separate(self):
+        x = _two_blobs()
+        cs = KMeansClustering(k=2, seed=3).apply_to(x)
+        assert len(cs.clusters) == 2
+        # each blob lands in one cluster
+        first_half = {cs.assignments[str(i)] for i in range(30)}
+        second_half = {cs.assignments[str(i)] for i in range(30, 60)}
+        assert len(first_half) == 1 and len(second_half) == 1
+        assert first_half != second_half
+        # centers near blob means
+        centers = sorted(cs.centers.tolist())
+        assert np.allclose(centers[0], [0, 0, 0], atol=0.5)
+        assert np.allclose(centers[1], [5, 5, 5], atol=0.5)
+
+    def test_nearest_cluster_and_stats(self):
+        x = _two_blobs()
+        cs = KMeansClustering(k=2, seed=1).apply_to(x)
+        c = cs.nearest_cluster(np.array([5.0, 5.0, 5.0], np.float32))
+        assert np.allclose(c.center, [5, 5, 5], atol=0.5)
+        assert cs.average_point_distance_to_center() < 1.0
+
+    def test_too_few_points_raises(self):
+        with pytest.raises(ValueError):
+            KMeansClustering(k=5).apply_to(np.zeros((3, 2), np.float32))
+
+
+class TestKDTree:
+    def test_knn_matches_bruteforce(self):
+        rng = np.random.RandomState(0)
+        data = rng.rand(200, 4)
+        tree = KDTree.build(data)
+        q = rng.rand(4)
+        got = [i for _, _, i in tree.knn(q, 5)]
+        want = np.argsort(np.linalg.norm(data - q, axis=1))[:5].tolist()
+        assert got == want
+
+    def test_insert_and_nn(self):
+        tree = KDTree(2)
+        pts = [[0, 0], [1, 1], [2, 2], [5, 5]]
+        for p in pts:
+            tree.insert(p)
+        d, pt = tree.nn([1.1, 1.1])
+        assert np.allclose(pt, [1, 1])
+        assert d == pytest.approx(np.sqrt(2 * 0.1 ** 2), abs=1e-9)
+
+    def test_range_query(self):
+        data = np.array([[0.1, 0.1], [0.5, 0.5], [0.9, 0.9], [2.0, 2.0]])
+        tree = KDTree.build(data)
+        inside = tree.range([0.0, 0.0], [1.0, 1.0])
+        assert sorted(i for _, i in inside) == [0, 1, 2]
+
+
+class TestVPTree:
+    def test_knn_matches_bruteforce(self):
+        rng = np.random.RandomState(1)
+        data = rng.rand(150, 8)
+        tree = VPTree(data)
+        q = rng.rand(8)
+        got = tree.words_nearest(q, 7)
+        want = np.argsort(np.linalg.norm(data - q, axis=1))[:7].tolist()
+        assert got == want
+
+    def test_cosine_metric(self):
+        data = np.array([[1.0, 0.0], [0.0, 1.0], [1.0, 0.01], [-1.0, 0.0]])
+        tree = VPTree(data, distance="cosine")
+        got = tree.words_nearest(np.array([1.0, 0.0]), 2)
+        assert set(got) == {0, 2}
+
+
+class TestQuadTree:
+    def test_center_of_mass_and_size(self):
+        data = np.array([[0.0, 0.0], [1.0, 0.0], [0.0, 1.0], [1.0, 1.0]])
+        tree = QuadTree.build(data)
+        assert tree.cum_size == 4
+        assert np.allclose(tree.center_of_mass, [0.5, 0.5])
+
+    def test_non_edge_forces_exact_when_theta_zero(self):
+        rng = np.random.RandomState(2)
+        data = rng.randn(30, 2)
+        tree = QuadTree.build(data)
+        # theta=0 forces exact all-pairs evaluation
+        for i in [0, 7]:
+            f = np.zeros(2)
+            sum_q = tree.compute_non_edge_forces(data[i], 0.0, f)
+            diff = data[i] - data
+            d2 = (diff ** 2).sum(1)
+            q = 1.0 / (1.0 + d2)
+            mask = d2 > 0
+            want_f = ((q ** 2)[mask, None] * diff[mask]).sum(0)
+            assert np.allclose(f, want_f, atol=1e-9)
+            assert sum_q == pytest.approx(q[mask].sum(), abs=1e-9)
+
+
+class TestSpTree:
+    def test_insert_counts(self):
+        rng = np.random.RandomState(3)
+        data = rng.randn(50, 3)
+        tree = SpTree.build(data)
+        assert tree.cum_size == 50
+        assert np.allclose(tree.center_of_mass, data.mean(0), atol=1e-9)
+
+    def test_non_edge_forces_exact_when_theta_zero(self):
+        rng = np.random.RandomState(4)
+        data = rng.randn(25, 3)
+        tree = SpTree.build(data)
+        f = np.zeros(3)
+        sum_q = tree.compute_non_edge_forces(data[5], 0.0, f)
+        diff = data[5] - data
+        d2 = (diff ** 2).sum(1)
+        q = 1.0 / (1.0 + d2)
+        mask = d2 > 0
+        assert np.allclose(f, ((q ** 2)[mask, None] * diff[mask]).sum(0),
+                           atol=1e-9)
+        assert sum_q == pytest.approx(q[mask].sum(), abs=1e-9)
+
+    def test_theta_approximation_close(self):
+        rng = np.random.RandomState(5)
+        data = rng.randn(100, 2)
+        tree = SpTree.build(data)
+        exact = np.zeros(2)
+        approx = np.zeros(2)
+        tree.compute_non_edge_forces(data[0], 0.0, exact)
+        tree.compute_non_edge_forces(data[0], 0.5, approx)
+        assert np.linalg.norm(exact - approx) < 0.1 * max(
+            np.linalg.norm(exact), 1e-9) + 0.05
+
+    def test_edge_forces(self):
+        data = np.array([[0.0, 0.0], [1.0, 0.0], [0.0, 2.0]])
+        # P: point 0 attracted to 1 (val .6) and 2 (val .4)
+        rows = np.array([0, 2, 2, 2])
+        cols = np.array([1, 2])
+        vals = np.array([0.6, 0.4])
+        pos_f = SpTree.compute_edge_forces(data, rows, cols, vals)
+        want0 = (0.6 / 2.0) * np.array([-1.0, 0.0]) + \
+                (0.4 / 5.0) * np.array([0.0, -2.0])
+        assert np.allclose(pos_f[0], want0)
+        assert np.allclose(pos_f[1], 0) and np.allclose(pos_f[2], 0)

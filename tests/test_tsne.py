@@ -1,0 +1,66 @@
+"""t-SNE smoke tests — reference `plot/TsneTest.java` /
+`BarnesHutTsneTest.java` parity: small real data, check the embedding
+separates structure and the loss decreases."""
+
+import numpy as np
+
+from deeplearning4j_tpu.plot import BarnesHutTsne, Tsne
+
+
+def _blob_data(n_per=20, seed=0):
+    rng = np.random.RandomState(seed)
+    a = rng.randn(n_per, 10) * 0.3
+    b = rng.randn(n_per, 10) * 0.3 + 4.0
+    x = np.vstack([a, b]).astype(np.float32)
+    labels = np.array([0] * n_per + [1] * n_per)
+    return x, labels
+
+
+def _separation(y, labels):
+    ya, yb = y[labels == 0], y[labels == 1]
+    between = np.linalg.norm(ya.mean(0) - yb.mean(0))
+    within = (np.linalg.norm(ya - ya.mean(0), axis=1).mean() +
+              np.linalg.norm(yb - yb.mean(0), axis=1).mean()) / 2
+    return between / max(within, 1e-9)
+
+
+class TestTsne:
+    def test_p_rows_sum_and_symmetry(self):
+        x, _ = _blob_data()
+        t = Tsne(perplexity=10.0)
+        p = np.asarray(t.compute_p(x))
+        assert np.allclose(p, p.T, atol=1e-7)
+        assert np.isclose(p.sum(), 1.0, atol=1e-5)
+        assert np.all(np.diag(p) < 1e-6)
+
+    def test_embedding_separates_blobs(self):
+        # small-n settings: big-lr + 0.8 momentum defaults are tuned for
+        # thousands of points and oscillate at n=40
+        x, labels = _blob_data()
+        t = Tsne(max_iter=600, perplexity=10.0, seed=0, learning_rate=10.0,
+                 final_momentum=0.5, stop_lying_iter=100, exaggeration=4.0)
+        y = t.calculate(x)
+        assert y.shape == (40, 2)
+        assert np.all(np.isfinite(y))
+        assert _separation(y, labels) > 2.0
+        # KL decreased over the run
+        assert t.kl_history[-1] < t.kl_history[0]
+
+
+class TestBarnesHutTsne:
+    def test_sparse_p_valid(self):
+        x, _ = _blob_data(n_per=15)
+        bh = BarnesHutTsne(perplexity=5.0)
+        rows, cols, vals = bh.compute_gaussian_perplexity(x)
+        assert rows[-1] == len(cols) == len(vals)
+        assert np.isclose(vals.sum(), 1.0, atol=1e-6)
+        assert np.all(vals >= 0)
+
+    def test_embedding_separates_blobs(self):
+        x, labels = _blob_data(n_per=15, seed=1)
+        bh = BarnesHutTsne(max_iter=150, perplexity=5.0, theta=0.5, seed=0)
+        y = bh.calculate(x)
+        assert y.shape == (30, 2)
+        assert np.all(np.isfinite(y))
+        assert _separation(y, labels) > 2.0
+        assert bh.params() is y
