@@ -1,0 +1,16 @@
+"""Command-line interface.
+
+Parity: reference `deeplearning4j-cli` (28 files / 1,450 LoC) —
+`cli/subcommands/{Train,Test,Predict}.java` with `--input --model --output
+--runtime --properties` flags and URI-scheme input loaders
+(`cli/api/schemes/`). The reference's `Train.exec()` is an empty stub
+(`Train.java:55-57`); this CLI actually executes (SURVEY §7: exceed the
+reference here).
+
+Run as `python -m deeplearning4j_tpu.cli <train|test|predict> ...` or via
+the `dl4j-tpu` console entry point.
+"""
+
+from deeplearning4j_tpu.cli.driver import main
+
+__all__ = ["main"]

@@ -1,0 +1,52 @@
+"""Input URI-scheme loaders.
+
+Parity: reference `cli/api/schemes/` + `cli/files/FileScheme` — map an
+`--input` string onto a DataSet. Supported:
+  - builtin datasets: `mnist[:n]`, `iris[:n]`, `lfw[:n]`, `curves[:n]`
+  - csv files: `csv:/path/to/file.csv[:label_col]` or a bare `*.csv` path
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.datasets.fetchers import (
+    CSVDataFetcher, CurvesDataFetcher, IrisDataFetcher, LFWDataFetcher,
+    MnistDataFetcher)
+
+_BUILTIN_DEFAULT_N = {"mnist": 10000, "iris": 150, "lfw": 1000,
+                      "curves": 1000}
+
+
+def load_input(uri: str, label_column: int = -1,
+               num_examples: Optional[int] = None) -> DataSet:
+    """Resolve an --input URI to a DataSet."""
+    scheme, _, rest = uri.partition(":")
+    scheme = scheme.lower()
+
+    if scheme in _BUILTIN_DEFAULT_N:
+        n = num_examples or (int(rest) if rest else _BUILTIN_DEFAULT_N[scheme])
+        fetcher = {"mnist": MnistDataFetcher, "iris": IrisDataFetcher,
+                   "lfw": LFWDataFetcher, "curves": CurvesDataFetcher}[scheme]()
+        return fetcher.fetch(n)
+
+    if scheme == "csv" or uri.endswith(".csv"):
+        if scheme == "csv":
+            # split at the LAST colon, and only when the suffix is an
+            # integer, so paths containing ':' (drive letters, timestamps)
+            # survive
+            path, _, col = rest.rpartition(":")
+            if path and col.lstrip("-").isdigit():
+                lc = int(col)
+            else:
+                path, lc = rest, label_column
+        else:
+            path, lc = uri, label_column
+        data = CSVDataFetcher(path, label_column=lc).fetch(
+            num_examples or int(1e9))
+        return data
+
+    raise ValueError(
+        f"unrecognized --input '{uri}': expected mnist/iris/lfw/curves, "
+        "csv:<path>[:label_col], or a .csv path")

@@ -1,0 +1,20 @@
+"""Shared utilities.
+
+Parity: reference `util/` (28 files / 6,000 LoC — `MathUtils.java`,
+`SerializationUtils.java`, `DiskBasedQueue.java`, `MultiDimensionalMap`,
+`Viterbi.java`, `TimeSeriesUtils`, `StringGrid`/`FingerPrintKeyer`) and the
+vendored `berkeley/` collections (`Counter`, `CounterMap`, `Pair`,
+`SloppyMath`).
+"""
+
+from deeplearning4j_tpu.utils.collections import (
+    Counter, CounterMap, Index, MultiDimensionalMap)
+from deeplearning4j_tpu.utils.disk_queue import DiskBasedQueue
+from deeplearning4j_tpu.utils.serialization import (
+    load_object, save_object)
+from deeplearning4j_tpu.utils.viterbi import Viterbi
+
+__all__ = [
+    "Counter", "CounterMap", "Index", "MultiDimensionalMap",
+    "DiskBasedQueue", "load_object", "save_object", "Viterbi",
+]

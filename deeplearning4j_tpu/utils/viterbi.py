@@ -1,0 +1,69 @@
+"""Viterbi sequence decoder as a `lax.scan` program.
+
+Parity: reference `util/Viterbi.java` (194 LoC — most-likely state sequence
+given per-step observation likelihoods and a transition model; used for
+sequence labeling over moving-window outputs).
+
+TPU-native design: the forward max-product pass is one `lax.scan` over
+time with (states,) carries — the whole decode jit-compiles to a single
+XLA while loop; backtracking is a second scan over the argmax pointers.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def _viterbi_decode(log_init: jnp.ndarray, log_trans: jnp.ndarray,
+                    log_obs: jnp.ndarray):
+    """log_init (S,), log_trans (S, S) [from, to], log_obs (T, S) ->
+    (path (T,), best_log_prob)."""
+
+    def forward(delta, obs_t):
+        # scores[i, j] = delta[i] + trans[i, j]
+        scores = delta[:, None] + log_trans
+        best_prev = jnp.argmax(scores, axis=0)
+        delta_t = jnp.max(scores, axis=0) + obs_t
+        return delta_t, best_prev
+
+    delta0 = log_init + log_obs[0]
+    delta_T, back = jax.lax.scan(forward, delta0, log_obs[1:])
+    last = jnp.argmax(delta_T)
+    best = delta_T[last]
+
+    def backward(state, back_t):
+        prev = back_t[state]
+        return prev, prev  # y[t-1] = state at t-1
+
+    _, prefix = jax.lax.scan(backward, last, back, reverse=True)
+    path = jnp.concatenate([prefix, last[None]])
+    return path, best
+
+
+class Viterbi:
+    """`Viterbi(possibleLabels)` parity facade over the jitted decode."""
+
+    def __init__(self, n_states: int, log_init=None, log_trans=None):
+        self.n_states = n_states
+        self.log_init = (jnp.zeros(n_states) if log_init is None
+                         else jnp.asarray(log_init))
+        self.log_trans = (jnp.zeros((n_states, n_states))
+                          if log_trans is None else jnp.asarray(log_trans))
+
+    def decode(self, log_obs) -> tuple:
+        """log_obs (T, S) per-step log-likelihoods -> (path (T,) ndarray,
+        best log prob)."""
+        log_obs = jnp.asarray(log_obs)
+        path, best = _viterbi_decode(self.log_init, self.log_trans, log_obs)
+        return np.asarray(path), float(best)
+
+    def decode_from_probs(self, probs) -> tuple:
+        """Convenience over raw (T, S) probabilities (reference passes
+        network outputs)."""
+        p = jnp.maximum(jnp.asarray(probs), 1e-30)
+        return self.decode(jnp.log(p))

@@ -1,0 +1,114 @@
+"""CLI tests — reference `deeplearning4j-cli` test parity (flag parsing)
+plus real end-to-end exec, which the reference stubs out
+(`Train.java:55-57`)."""
+
+import csv
+import json
+import os
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.cli.driver import build_parser, main
+from deeplearning4j_tpu.cli.schemes import load_input
+from deeplearning4j_tpu.nn.conf import (
+    LayerType, NeuralNetConfiguration, OptimizationAlgorithm, list_builder)
+
+
+@pytest.fixture(scope="module")
+def iris_conf_json(tmp_path_factory):
+    base = NeuralNetConfiguration(
+        activation="tanh", lr=0.1,
+        optimization_algo=OptimizationAlgorithm.CONJUGATE_GRADIENT,
+        num_iterations=40, seed=1)
+    conf = (list_builder(base, 2).hidden_layer_sizes([10], n_in=4, n_out=3)
+            .override(1, layer_type=LayerType.OUTPUT).build())
+    p = tmp_path_factory.mktemp("conf") / "iris.json"
+    p.write_text(conf.to_json())
+    return str(p)
+
+
+class TestFlags:
+    def test_parser_requires_subcommand(self):
+        with pytest.raises(SystemExit):
+            build_parser().parse_args([])
+
+    def test_train_flags(self):
+        args = build_parser().parse_args(
+            ["train", "--input", "iris", "--model", "m.json",
+             "--output", "out", "--runtime", "mesh",
+             "--properties", "epochs=2,batch=32"])
+        assert args.runtime == "mesh"
+        assert args.input == "iris"
+
+    def test_bad_runtime_rejected(self):
+        with pytest.raises(SystemExit):
+            build_parser().parse_args(
+                ["train", "--input", "iris", "--model", "m", "--output",
+                 "o", "--runtime", "spark"])
+
+
+class TestSchemes:
+    def test_builtin_iris(self):
+        d = load_input("iris")
+        assert d.features.shape == (150, 4)
+        assert d.labels.shape == (150, 3)
+
+    def test_builtin_with_count(self):
+        d = load_input("iris:50")
+        assert d.features.shape[0] == 50
+
+    def test_csv_scheme(self, tmp_path):
+        p = tmp_path / "d.csv"
+        with open(p, "w", newline="") as f:
+            w = csv.writer(f)
+            for i in range(10):
+                w.writerow([i * 0.1, i * 0.2, i % 2])
+        d = load_input(f"csv:{p}:2")
+        assert d.features.shape == (10, 2)
+        assert d.labels.shape == (10, 2)
+
+    def test_unknown_scheme_raises(self):
+        with pytest.raises(ValueError):
+            load_input("ftp://nope")
+
+
+class TestEndToEnd:
+    def test_train_test_predict_cycle(self, iris_conf_json, tmp_path,
+                                      capsys):
+        out = str(tmp_path / "model")
+        rc = main(["train", "--input", "iris", "--model", iris_conf_json,
+                   "--output", out, "--normalize"])
+        assert rc == 0
+        saved = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+        assert saved["saved"] == out
+        assert os.path.isdir(out)
+
+        rc = main(["test", "--input", "iris", "--model", out,
+                   "--normalize"])
+        assert rc == 0
+        stats = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+        assert stats["accuracy"] > 0.9
+
+        pred_csv = str(tmp_path / "preds.csv")
+        rc = main(["predict", "--input", "iris", "--model", out,
+                   "--normalize", "--output", pred_csv])
+        assert rc == 0
+        with open(pred_csv) as f:
+            rows = list(csv.reader(f))
+        assert rows[0][0] == "prediction"
+        assert len(rows) == 151
+        preds = np.array([int(r[0]) for r in rows[1:]])
+        assert set(preds.tolist()) <= {0, 1, 2}
+
+    def test_train_mesh_runtime(self, iris_conf_json, tmp_path, capsys):
+        out = str(tmp_path / "model-mesh")
+        rc = main(["train", "--input", "iris:144", "--model", iris_conf_json,
+                   "--output", out, "--runtime", "mesh", "--normalize",
+                   "--properties", "epochs=30,batch=48"])
+        assert rc == 0
+        assert os.path.isdir(out)
+        rc = main(["test", "--input", "iris", "--model", out, "--normalize"])
+        assert rc == 0
+        stats = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+        assert stats["accuracy"] > 0.7

@@ -1,0 +1,156 @@
+"""Utility tests — reference `util/` test parity (MathUtilsTest,
+ViterbiTest behavior, DiskBasedQueue, collections)."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.utils import (
+    Counter, CounterMap, DiskBasedQueue, Index, MultiDimensionalMap,
+    Viterbi, load_object, save_object)
+from deeplearning4j_tpu.utils import math_utils as mu
+from deeplearning4j_tpu.utils.string_grid import StringGrid, fingerprint
+from deeplearning4j_tpu.utils.timeseries import (
+    difference, lagged, moving_window_matrix)
+
+
+class TestMathUtils:
+    def test_normalize_and_discretize(self):
+        assert mu.normalize(5.0, 0.0, 10.0) == 0.5
+        assert mu.discretize(0.95, 0.0, 1.0, 10) == 9
+        assert mu.discretize(-5.0, 0.0, 1.0, 10) == 0
+
+    def test_entropy_information_gain(self):
+        assert mu.entropy([0.5, 0.5]) == pytest.approx(np.log(2))
+        assert mu.entropy([1.0]) == 0.0
+        ig = mu.information_gain([0.5, 0.5], [[1.0], [1.0]], [0.5, 0.5])
+        assert ig == pytest.approx(np.log(2))
+
+    def test_log_add_matches_direct(self):
+        a, b = np.log(0.3), np.log(0.4)
+        assert mu.log_add(a, b) == pytest.approx(np.log(0.7))
+        assert mu.log_sum([a, b, np.log(0.3)]) == pytest.approx(0.0, abs=1e-12)
+
+    def test_stats(self):
+        x = [1.0, 2.0, 3.0, 4.0]
+        assert mu.mean(x) == 2.5
+        assert mu.variance(x) == pytest.approx(np.var(x, ddof=1))
+        assert mu.correlation(x, [2.0, 4.0, 6.0, 8.0]) == pytest.approx(1.0)
+        assert mu.euclidean_distance([0, 0], [3, 4]) == 5.0
+
+    def test_bernoullis(self):
+        assert mu.bernoullis(0.5, 2, 1) == pytest.approx(0.5)
+
+
+class TestViterbi:
+    def test_decodes_most_likely_path(self):
+        # 2 states; strong self-transitions; observations flip mid-sequence
+        log_trans = np.log(np.array([[0.9, 0.1], [0.1, 0.9]]))
+        v = Viterbi(2, log_init=np.log([0.5, 0.5]), log_trans=log_trans)
+        probs = np.array([[0.9, 0.1], [0.8, 0.2], [0.9, 0.1],
+                          [0.1, 0.9], [0.2, 0.8], [0.1, 0.9]])
+        path, best = v.decode_from_probs(probs)
+        assert path.tolist() == [0, 0, 0, 1, 1, 1]
+        assert np.isfinite(best)
+
+    def test_sticky_transitions_smooth_noise(self):
+        # a single noisy observation should not flip the state
+        log_trans = np.log(np.array([[0.99, 0.01], [0.01, 0.99]]))
+        v = Viterbi(2, log_trans=log_trans)
+        probs = np.array([[0.9, 0.1], [0.4, 0.6], [0.9, 0.1], [0.9, 0.1]])
+        path, _ = v.decode_from_probs(probs)
+        assert path.tolist() == [0, 0, 0, 0]
+
+
+class TestCollections:
+    def test_counter(self):
+        c = Counter()
+        c.increment_count("a", 2.0)
+        c.increment_count("b")
+        assert c.get_count("a") == 2.0
+        assert c.arg_max() == "a"
+        assert c.total_count() == 3.0
+        c.normalize()
+        assert c.get_count("b") == pytest.approx(1 / 3)
+        assert c.keys_sorted_by_count() == ["a", "b"]
+
+    def test_counter_map(self):
+        cm = CounterMap()
+        cm.increment_count("x", "y", 3.0)
+        cm.increment_count("x", "z", 1.0)
+        assert cm.get_count("x", "y") == 3.0
+        assert cm.get_count("missing", "y") == 0.0
+        assert cm.total_count() == 4.0
+
+    def test_multidimensional_map(self):
+        m = MultiDimensionalMap()
+        m.put(1, "a", "v")
+        assert m.get(1, "a") == "v"
+        assert m.contains(1, "a") and not m.contains(1, "b")
+        m.remove(1, "a")
+        assert len(m) == 0
+
+    def test_index(self):
+        idx = Index()
+        assert idx.add("w") == 0
+        assert idx.add("w") == 0
+        assert idx.add("v") == 1
+        assert idx.index_of("v") == 1
+        assert idx.index_of("missing") == -1
+        assert idx.get(0) == "w"
+
+
+class TestDiskQueue:
+    def test_fifo_roundtrip(self, tmp_path):
+        q = DiskBasedQueue(str(tmp_path / "q"))
+        for i in range(5):
+            q.add({"i": i, "arr": np.arange(3) * i})
+        assert len(q) == 5
+        assert q.peek()["i"] == 0
+        got = [q.poll()["i"] for _ in range(5)]
+        assert got == [0, 1, 2, 3, 4]
+        assert q.poll() is None and q.is_empty()
+        q.close()
+
+
+class TestSerialization:
+    def test_roundtrip(self, tmp_path):
+        obj = {"a": np.arange(4), "b": [1, "two"]}
+        p = str(tmp_path / "obj.pkl")
+        save_object(obj, p)
+        back = load_object(p)
+        assert back["b"] == [1, "two"]
+        assert np.array_equal(back["a"], obj["a"])
+
+
+class TestStringGrid:
+    def test_fingerprint_clusters_near_duplicates(self):
+        assert fingerprint("The  Quick, Brown!") == fingerprint(
+            "brown quick the")
+        g = StringGrid.from_lines(
+            ["Apple Inc.,1", "apple inc,2", "Banana,3"])
+        clusters = g.cluster_column(0)
+        assert sorted(map(len, clusters.values())) == [1, 2]
+        assert len(g.dedup_by_column(0)) == 2
+
+
+class TestTimeSeries:
+    def test_moving_window_matrix(self):
+        x = np.arange(5)
+        w = moving_window_matrix(x, 3)
+        assert w.shape == (3, 3)
+        assert w[0].tolist() == [0, 1, 2]
+        assert w[-1].tolist() == [2, 3, 4]
+        w2 = moving_window_matrix(x, 3, add_rotate=True)
+        assert w2.shape == (6, 3)
+
+    def test_lagged(self):
+        m = lagged(np.array([1, 2, 3, 4]), 2)
+        assert m.shape == (2, 3)
+        assert m[0].tolist() == [3, 2, 1]
+
+    def test_difference(self):
+        assert difference([1, 4, 9]).tolist() == [3, 5]
+
+    def test_window_too_large_raises(self):
+        with pytest.raises(ValueError):
+            moving_window_matrix(np.arange(3), 5)
