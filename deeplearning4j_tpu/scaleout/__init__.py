@@ -1,0 +1,23 @@
+"""Distributed NLP performers.
+
+Parity: reference `scaleout/perform/models/word2vec/Word2VecPerformer.java
+:50-426` (jobs = sentence batches against broadcast syn0/syn1 snapshots,
+results = row deltas merged by `Word2VecJobAggregator`), the GloVe twin
+(`scaleout/perform/models/glove/`), and the word-count example
+(`scaleout/perform/text/`).
+
+TPU-native split: the inner math is the SAME jitted batched kernel the
+single-process models use (`models/word2vec._w2v_step`); the scaleout layer
+only chunks work, snapshots tables, and merges sparse row deltas through
+the host coordinator (`parallel/coordinator.LocalRunner` — the
+BaseTestDistributed-style in-process rig which is also the multi-host
+control plane's local form).
+"""
+
+from deeplearning4j_tpu.scaleout.word2vec_performer import (
+    DistributedWord2Vec)
+from deeplearning4j_tpu.scaleout.glove_performer import DistributedGlove
+from deeplearning4j_tpu.scaleout.wordcount import distributed_word_count
+
+__all__ = ["DistributedWord2Vec", "DistributedGlove",
+           "distributed_word_count"]

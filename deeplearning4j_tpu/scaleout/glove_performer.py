@@ -1,0 +1,148 @@
+"""Distributed GloVe over the host coordinator.
+
+Parity: reference `scaleout/perform/models/glove/GlovePerformer.java` +
+`GloveJobIterator`/aggregator: the co-occurrence pair list is chunked into
+jobs; each worker runs AdaGrad steps against a state snapshot and returns
+parameter deltas; the master sums deltas per round (one round per epoch).
+Co-occurrence *counting* itself is chunked through the same runner
+(the reference used an actor pipeline, `CoOccurrenceActor`).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.models.glove import CoOccurrences, Glove, _glove_step
+from deeplearning4j_tpu.parallel.coordinator import LocalRunner, StateTracker
+
+
+class DistributedGlove(Glove):
+    def __init__(self, *args, n_workers: int = 4,
+                 tracker: Optional[StateTracker] = None, **kw):
+        super().__init__(*args, **kw)
+        self.n_workers = n_workers
+        self.tracker = tracker or StateTracker()
+
+    def _count_cooccurrences(self, token_lists) -> CoOccurrences:
+        """Chunked counting: each job counts a slice of sentences, the
+        aggregator merges count dicts (CoOccurrenceActor pipeline role)."""
+        id_lists = [[self.cache.index_of(t) for t in toks
+                     if t in self.cache] for toks in token_lists]
+        chunk = max(1, len(id_lists) // self.n_workers)
+        jobs = [id_lists[i:i + chunk]
+                for i in range(0, len(id_lists), chunk)]
+
+        def perform(sentence_ids):
+            co = CoOccurrences(self.window)
+            for ids in sentence_ids:
+                co.add_sentence(ids)
+            return co.counts
+
+        def aggregate(results: List[dict]):
+            merged = CoOccurrences(self.window)
+            for counts in results:
+                for k, v in counts.items():
+                    merged.counts[k] = merged.counts.get(k, 0.0) + v
+            return merged
+
+        runner = LocalRunner(perform, aggregate, n_workers=self.n_workers,
+                             tracker=self.tracker)
+        return runner.run(jobs)
+
+    def fit(self, sentences=None) -> "DistributedGlove":
+        sentences = sentences if sentences is not None else self.sentences
+        token_lists = [self.tokenizer.tokenize(s) if isinstance(s, str)
+                       else list(s) for s in sentences]
+        from deeplearning4j_tpu.text.vocab import VocabCache
+        from deeplearning4j_tpu.models.embeddings import InMemoryLookupTable
+
+        self.cache = VocabCache(self.min_word_frequency).fit(token_lists)
+        co = self._count_cooccurrences(token_lists)
+        wi, wj, x = co.arrays()
+        self.table = InMemoryLookupTable(self.cache, self.vector_length,
+                                         self.seed)
+        if len(x) == 0:
+            return self
+
+        n = self.cache.num_words()
+        k1, k2 = jax.random.split(jax.random.PRNGKey(self.seed))
+        scale = 0.5 / self.vector_length
+        state = {"params": {
+            "w": jax.random.uniform(k1, (n, self.vector_length),
+                                    minval=-scale, maxval=scale),
+            "wt": jax.random.uniform(k2, (n, self.vector_length),
+                                     minval=-scale, maxval=scale),
+            "b": jnp.zeros((n,)), "bt": jnp.zeros((n,))}}
+        state["hist"] = jax.tree_util.tree_map(
+            lambda p: jnp.zeros_like(p), state["params"])
+        shared = {"state": state}
+        lock = threading.Lock()
+
+        logx = np.log(x)
+        fx = np.minimum(1.0, (x / self.x_max) ** self.alpha).astype(
+            np.float32)
+        B = min(self.batch_size, len(x))
+
+        def perform(idx: np.ndarray):
+            # deep-copy: _glove_step donates its input buffers, so the
+            # shared state must never be passed in directly, and the start
+            # snapshot must live on host
+            with lock:
+                start_params = jax.tree_util.tree_map(
+                    np.array, shared["state"]["params"])
+                cur = jax.tree_util.tree_map(jnp.array, shared["state"])
+            # per-job batch: padding to the dataset-global B would
+            # over-train short chunks (see word2vec_performer)
+            b_job = min(B, len(idx))
+            for s in range(0, len(idx), b_job):
+                b = idx[s:s + b_job]
+                if len(b) < b_job:
+                    b = np.resize(b, b_job)
+                cur, _ = _glove_step(
+                    cur, jnp.asarray(wi[b]), jnp.asarray(wj[b]),
+                    jnp.asarray(logx[b]), jnp.asarray(fx[b]),
+                    jnp.asarray(self.lr, jnp.float32))
+            # delta on params; hist merges by max (monotone accumulator)
+            return {
+                "dparams": jax.tree_util.tree_map(
+                    lambda a, b_: np.asarray(a - b_),
+                    cur["params"], start_params),
+                "hist": jax.tree_util.tree_map(np.asarray, cur["hist"]),
+            }
+
+        def aggregate(results: List[dict]):
+            with lock:
+                st = shared["state"]
+                params = st["params"]
+                hist = st["hist"]
+                for res in results:
+                    if not res:
+                        continue
+                    params = jax.tree_util.tree_map(
+                        lambda p, d: p + jnp.asarray(d), params,
+                        res["dparams"])
+                    hist = jax.tree_util.tree_map(
+                        lambda h, h2: jnp.maximum(h, jnp.asarray(h2)),
+                        hist, res["hist"])
+                shared["state"] = {"params": params, "hist": hist}
+            return None
+
+        rng = np.random.RandomState(self.seed)
+        for _ in range(self.epochs):
+            perm = rng.permutation(len(x))
+            chunk = max(1, len(perm) // self.n_workers)
+            jobs = [perm[i:i + chunk]
+                    for i in range(0, len(perm), chunk)]
+            runner = LocalRunner(perform, aggregate,
+                                 n_workers=self.n_workers,
+                                 tracker=self.tracker)
+            runner.run(jobs)
+
+        p = shared["state"]["params"]
+        self.table.syn0 = p["w"] + p["wt"]
+        return self

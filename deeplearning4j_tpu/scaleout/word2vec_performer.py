@@ -1,0 +1,167 @@
+"""Distributed Word2Vec over the host coordinator.
+
+Parity: reference `Word2VecPerformer.java:50-426` + `Word2VecJobIterator` /
+`Word2VecJobAggregator`: workers train sentence batches against a snapshot
+of the lookup table and ship back row deltas; the master merges deltas into
+the shared table each round (BSP) or eagerly (HogWild).
+
+Docstring contract: job work = (pair-chunk arrays); job result = sparse
+{row-index -> delta} per table. The device math per job is the identical
+jitted `_w2v_step` used by the single-process `models/word2vec.Word2Vec`.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.models.word2vec import Word2Vec, _w2v_step
+from deeplearning4j_tpu.parallel.coordinator import LocalRunner, StateTracker
+from deeplearning4j_tpu.text.vocab import Huffman
+
+
+def _row_deltas(new: np.ndarray, old: np.ndarray,
+                touched: np.ndarray) -> Dict[int, np.ndarray]:
+    """Sparse {row -> new-old} over the touched row set."""
+    return {int(r): np.asarray(new[r] - old[r]) for r in touched}
+
+
+class DistributedWord2Vec(Word2Vec):
+    """Word2Vec whose fit() runs as coordinator jobs.
+
+    hogwild=False → BSP rounds (one per epoch): every worker trains on the
+    same table snapshot, deltas are summed then applied
+    (iterative-reduce semantics).
+    hogwild=True  → each job applies its deltas to the shared tables the
+    moment it finishes (HogWildWorkRouter semantics); snapshot staleness
+    between jobs is racy-by-design, like the reference.
+    """
+
+    def __init__(self, *args, n_workers: int = 4, hogwild: bool = False,
+                 jobs_per_round: Optional[int] = None,
+                 tracker: Optional[StateTracker] = None, **kw):
+        super().__init__(*args, **kw)
+        self.n_workers = n_workers
+        self.hogwild = hogwild
+        self.jobs_per_round = jobs_per_round
+        self.tracker = tracker or StateTracker()
+
+    def fit(self, sentences=None) -> "DistributedWord2Vec":
+        sentences = sentences if sentences is not None else self.sentences
+        token_lists = [self.tokenize(s) if isinstance(s, str) else list(s)
+                       for s in sentences]
+        if self.cache is None:
+            self.build_vocab(token_lists)
+        ids = [np.asarray([self.cache.index_of(t) for t in toks
+                           if t in self.cache], np.int32)
+               for toks in token_lists]
+        centers, contexts = self._pairs(ids)
+        if len(centers) == 0:
+            return self
+
+        codes_all, points_all, mask_all = Huffman.padded_arrays(self.cache)
+        if not self.use_hs:
+            mask_all = np.zeros_like(mask_all)
+        neg_logits = jnp.log(jnp.asarray(
+            self.table.unigram_table_probs()) + 1e-30)
+        n_rows = self.cache.num_words()
+        syn1neg0 = (self.table.syn1neg if self.table.syn1neg is not None
+                    else np.zeros((n_rows, self.vector_length), np.float32))
+        # np.array (copy): np.asarray over jax arrays is read-only, and
+        # aggregate() mutates these in place
+        tables = {"syn0": np.array(self.table.syn0, np.float32),
+                  "syn1": np.array(self.table.syn1, np.float32),
+                  "syn1neg": np.array(syn1neg0, np.float32)}
+
+        # chunk the pair stream into jobs (Word2VecJobIterator role)
+        n_jobs = self.jobs_per_round or self.n_workers
+        pairs_total = max(1, self.epochs * len(centers))
+        base_key = jax.random.PRNGKey(self.seed)
+        B = self.batch_size
+
+        import threading
+        apply_lock = threading.Lock()
+
+        def _apply(deltas_by_table: dict) -> None:
+            for name, deltas in deltas_by_table.items():
+                tbl = tables[name]
+                for r, d in deltas.items():
+                    tbl[r] += d
+
+        def perform(work: Tuple[int, int, np.ndarray, np.ndarray]):
+            """Train one pair chunk against the current snapshot; return
+            sparse row deltas (Word2VecResult role). Keys and alpha are
+            derived from the job's (epoch, index, step) position, so BSP
+            runs are deterministic for a fixed seed across any worker
+            interleaving."""
+            epoch_i, job_i, pair_offset, c_np, t_np = work
+            with apply_lock:  # consistent snapshot under hogwild
+                start = {k: np.array(v) for k, v in tables.items()}
+            cur = {k: jnp.asarray(v) for k, v in start.items()}
+            job_key = jax.random.fold_in(
+                jax.random.fold_in(base_key, epoch_i), job_i)
+            # per-job batch: padding a short chunk to the global batch size
+            # would over-train its pairs relative to the serial model
+            b_job = min(B, len(c_np))
+            for step_i, s in enumerate(range(0, len(c_np), b_job)):
+                cb, tb = c_np[s:s + b_job], t_np[s:s + b_job]
+                if len(cb) < b_job:
+                    pad = b_job - len(cb)
+                    cb = np.concatenate([cb, np.resize(cb, pad)])
+                    tb = np.concatenate([tb, np.resize(tb, pad)])
+                # linear alpha decay by global pair progress
+                done = epoch_i * len(centers) + pair_offset + s
+                alpha = max(self.min_alpha,
+                            self.alpha * (1 - done / pairs_total))
+                sub = jax.random.fold_in(job_key, step_i)
+                cur, _ = _w2v_step(
+                    cur, jnp.asarray(cb), jnp.asarray(tb),
+                    jnp.asarray(codes_all[tb]), jnp.asarray(points_all[tb]),
+                    jnp.asarray(mask_all[tb]), neg_logits, sub,
+                    jnp.asarray(alpha, jnp.float32), self.negative)
+            touched = np.unique(np.concatenate([c_np, t_np]))
+            deltas = {
+                "syn0": _row_deltas(np.asarray(cur["syn0"]),
+                                    start["syn0"], touched),
+                # syn1 (Huffman inner nodes) / syn1neg rows move via points
+                # and negative draws — diff their full (smaller) tables
+                "syn1": _row_deltas(np.asarray(cur["syn1"]), start["syn1"],
+                                    np.arange(len(start["syn1"]))),
+                "syn1neg": _row_deltas(np.asarray(cur["syn1neg"]),
+                                       start["syn1neg"],
+                                       np.arange(len(start["syn1neg"]))),
+            }
+            if self.hogwild:  # apply eagerly, return nothing to aggregate
+                with apply_lock:
+                    _apply(deltas)
+                return {}
+            return deltas
+
+        def aggregate(results: List[dict]):
+            """Merge row deltas into the shared tables
+            (Word2VecJobAggregator.accumulate semantics: sum deltas)."""
+            with apply_lock:
+                for res in results:
+                    if res:
+                        _apply(res)
+            return None
+
+        rng = np.random.RandomState(self.seed)
+        for epoch_i in range(self.epochs):
+            perm = rng.permutation(len(centers))
+            chunk = max(1, len(perm) // n_jobs)
+            jobs = [(epoch_i, j, i, centers[perm[i:i + chunk]],
+                     contexts[perm[i:i + chunk]])
+                    for j, i in enumerate(range(0, len(perm), chunk))]
+            runner = LocalRunner(perform, aggregate,
+                                 n_workers=self.n_workers,
+                                 hogwild=self.hogwild, tracker=self.tracker)
+            runner.run(jobs)
+
+        self.table.syn0 = jnp.asarray(tables["syn0"])
+        self.table.syn1 = jnp.asarray(tables["syn1"])
+        self.table.syn1neg = jnp.asarray(tables["syn1neg"])
+        return self

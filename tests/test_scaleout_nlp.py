@@ -1,0 +1,89 @@
+"""Distributed NLP tests — reference `DistributedWord2VecTest`,
+`DistributedGloveTest`, `WordCountTest` parity (in-process rig,
+`BaseTestDistributed.java:34-98` style) + config registry
+(`TestZookeeperRegister` parity)."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.parallel.config_registry import (
+    ConfigRegistry, ConfigRegistryServer, RemoteConfigRegistry)
+from deeplearning4j_tpu.scaleout import (
+    DistributedGlove, DistributedWord2Vec, distributed_word_count)
+
+CORPUS = [
+    "the king rules the kingdom with a crown",
+    "the queen rules the kingdom with grace",
+    "king and queen sit on the royal throne",
+    "the cat chases the mouse in the house",
+    "a cat and a mouse live in the old house",
+    "dogs chase cats and cats chase mice daily",
+    "the king wears the royal crown of gold",
+    "the queen wears a golden crown today",
+    "mouse and cat play in the house garden",
+    "royal king and royal queen rule together",
+] * 6
+
+
+class TestDistributedWordCount:
+    def test_counts_match_serial(self):
+        c = distributed_word_count(CORPUS, n_workers=3)
+        assert c.get_count("the") > 0
+        # spot check against direct count
+        want = sum(s.split().count("king") for s in CORPUS)
+        assert c.get_count("king") == want
+
+
+class TestDistributedWord2Vec:
+    @pytest.mark.parametrize("hogwild", [False, True])
+    def test_trains_and_matches_single_process_quality(self, hogwild):
+        w2v = DistributedWord2Vec(
+            CORPUS, vector_length=24, window=4, min_word_frequency=2,
+            negative=3, epochs=6, batch_size=256, seed=7,
+            n_workers=3, hogwild=hogwild)
+        w2v.fit()
+        # related words should be closer than unrelated ones
+        assert w2v.similarity("king", "queen") > w2v.similarity(
+            "king", "mouse")
+        v = w2v.vector("king")
+        assert v.shape == (24,) and np.all(np.isfinite(np.asarray(v)))
+
+    def test_tracker_saw_jobs(self):
+        from deeplearning4j_tpu.parallel.coordinator import StateTracker
+        tr = StateTracker()
+        DistributedWord2Vec(CORPUS[:20], vector_length=8, epochs=1,
+                            min_word_frequency=2, n_workers=2,
+                            tracker=tr).fit()
+        assert tr.count("jobs_done") > 0
+
+
+class TestDistributedGlove:
+    def test_trains_sane_vectors(self):
+        g = DistributedGlove(CORPUS, vector_length=16, window=6,
+                             epochs=8, lr=0.05, seed=3, n_workers=3)
+        g.fit()
+        v = g.vector("king")
+        assert v.shape == (16,) and np.all(np.isfinite(np.asarray(v)))
+        assert g.similarity("king", "queen") > g.similarity("king", "mouse")
+
+
+class TestConfigRegistry:
+    def test_file_backed_roundtrip(self, tmp_path):
+        reg = ConfigRegistry(str(tmp_path))
+        reg.register("host1/2510/conf", {"lr": 0.1, "layers": [4, 3]})
+        back = reg.retrieve("host1/2510/conf")
+        assert back == {"lr": 0.1, "layers": [4, 3]}
+        assert reg.list_keys() == ["host1/2510/conf"]
+        reg.delete("host1/2510/conf")
+        assert reg.retrieve("host1/2510/conf") is None
+
+    def test_http_server_roundtrip(self, tmp_path):
+        srv = ConfigRegistryServer(str(tmp_path)).start()
+        try:
+            client = RemoteConfigRegistry(srv.url)
+            client.register("job/42", {"batch": 128})
+            assert client.retrieve("job/42") == {"batch": 128}
+            assert "job/42" in client.list_keys()
+            assert client.retrieve("missing") is None
+        finally:
+            srv.stop()

@@ -1,0 +1,112 @@
+"""UI server + plotting tests — reference `deeplearning4j-ui` resource
+behavior and `plot/NeuralNetPlotter`/`FilterRenderer` capability."""
+
+import json
+import os
+import urllib.request
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.plot.plotter import (
+    FilterRenderer, NeuralNetPlotter, PlotIterationListener)
+from deeplearning4j_tpu.ui import UiServer
+
+
+def _get(url):
+    with urllib.request.urlopen(url, timeout=10) as r:
+        return json.loads(r.read())
+
+
+def _post(url, body):
+    req = urllib.request.Request(
+        url, data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=30) as r:
+        return json.loads(r.read())
+
+
+@pytest.fixture(scope="module")
+def server():
+    s = UiServer().start()
+    yield s
+    s.stop()
+
+
+class TestUiServer:
+    def test_coords_roundtrip(self, server):
+        coords = [[0.0, 1.0], [2.0, 3.0]]
+        out = _post(server.url + "/api/coords",
+                    {"coords": coords, "labels": ["a", "b"]})
+        assert out["n"] == 2
+        back = _get(server.url + "/api/coords")
+        assert back["coords"] == coords
+        assert back["labels"] == ["a", "b"]
+
+    def test_nearest_neighbors(self, server):
+        rng = np.random.RandomState(0)
+        vecs = rng.randn(20, 8)
+        vecs[3] = vecs[7] + 0.001  # make w3 ~ w7
+        labels = [f"w{i}" for i in range(20)]
+        _post(server.url + "/api/vectors",
+              {"vectors": vecs.tolist(), "labels": labels})
+        out = _get(server.url + "/api/nearest?word=w3&k=3")
+        assert out["nearest"][0] == "w7"
+
+    def test_nearest_unknown_word_404(self, server):
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _get(server.url + "/api/nearest?word=zzz")
+        assert e.value.code == 404
+
+    def test_server_side_tsne(self, server):
+        rng = np.random.RandomState(1)
+        vecs = np.vstack([rng.randn(10, 5), rng.randn(10, 5) + 4])
+        _post(server.url + "/api/vectors", {"vectors": vecs.tolist(),
+                                            "labels": []})
+        out = _post(server.url + "/api/tsne",
+                    {"iters": 150, "perplexity": 5.0})
+        assert out["n"] == 20
+        coords = _get(server.url + "/api/coords")["coords"]
+        assert len(coords) == 20
+
+    def test_weights_endpoint(self, server):
+        _post(server.url + "/api/weights",
+              {"0/W": np.random.RandomState(2).randn(10, 4).tolist()})
+        out = _get(server.url + "/api/weights")
+        assert "0/W" in out
+        assert len(out["0/W"]["hist"]) == 30
+
+    def test_html_view(self, server):
+        with urllib.request.urlopen(server.url + "/", timeout=10) as r:
+            assert b"canvas" in r.read()
+
+
+class TestPlotter:
+    def test_weight_histograms(self, tmp_path):
+        p = NeuralNetPlotter(str(tmp_path))
+        params = ({"W": np.random.randn(10, 5), "b": np.zeros(5)},
+                  {"W": np.random.randn(5, 2)})
+        path = p.plot_weight_histograms(params)
+        assert os.path.isfile(path) and os.path.getsize(path) > 0
+
+    def test_filter_renderer_dense_and_conv(self, tmp_path):
+        f = FilterRenderer(str(tmp_path))
+        path = f.render_filters(np.random.randn(16, 6), name="dense")
+        assert os.path.isfile(path)
+        path = f.render_filters(np.random.randn(3, 3, 1, 8), name="conv")
+        assert os.path.isfile(path)
+
+    def test_filter_bad_shape_raises(self, tmp_path):
+        with pytest.raises(ValueError):
+            FilterRenderer(str(tmp_path)).render_filters(
+                np.random.randn(7, 4))
+
+    def test_plot_listener(self, tmp_path):
+        class FakeModel:
+            params = ({"W": np.random.randn(4, 3)},)
+
+        li = PlotIterationListener(str(tmp_path), every=2)
+        for i in range(4):
+            li.iteration_done(FakeModel(), i, 1.0 / (i + 1))
+        assert any(n.startswith("weights-") for n in os.listdir(tmp_path))
+        assert os.path.isfile(os.path.join(tmp_path, "score.png"))
