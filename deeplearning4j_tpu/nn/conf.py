@@ -49,6 +49,7 @@ class LayerType(str, enum.Enum):
     SUBSAMPLING = "subsampling"
     BATCH_NORM = "batch_norm"
     EMBEDDING = "embedding"
+    ATTENTION = "attention"
 
     def __str__(self) -> str:
         return self.value
@@ -140,6 +141,11 @@ class NeuralNetConfiguration:
     k: int = 1                      # CD-k Gibbs steps (RBM.java:121-201)
     visible_unit: RBMUnit = RBMUnit.BINARY
     hidden_unit: RBMUnit = RBMUnit.BINARY
+
+    # attention knobs (new scope — no attention in the 2015 reference)
+    n_heads: int = 4
+    causal: bool = False
+    attention_block_size: int = 0  # 0 = full attention; >0 = blockwise/flash
 
     # conv knobs (NCHW)
     kernel_size: Tuple[int, int] = (5, 5)

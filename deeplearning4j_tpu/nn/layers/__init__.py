@@ -12,7 +12,8 @@ replacing the reference's `Model.gradientAndScore` contract
 """
 
 from deeplearning4j_tpu.nn.conf import LayerType
-from deeplearning4j_tpu.nn.layers import base, output, autoencoder, rbm, lstm, conv
+from deeplearning4j_tpu.nn.layers import (base, output, autoencoder, rbm, lstm,
+                                          conv, attention)
 
 _REGISTRY = {
     LayerType.DENSE: base.DenseLayer,
@@ -28,6 +29,7 @@ _REGISTRY = {
     LayerType.SUBSAMPLING: conv.SubsamplingLayer,
     LayerType.BATCH_NORM: base.BatchNormLayer,
     LayerType.EMBEDDING: base.EmbeddingLayer,
+    LayerType.ATTENTION: attention.MultiHeadAttentionLayer,
 }
 
 

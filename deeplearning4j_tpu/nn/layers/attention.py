@@ -1,0 +1,76 @@
+"""Multi-head self-attention layer.
+
+New-scope capability: the reference framework predates attention entirely
+(its only sequence model is the scalar-loop LSTM, `LSTM.java:161-228`); this
+layer plus `parallel/sequence.py` is the TPU-native long-context replacement.
+Input/output shape [batch, seq, n_in]; params follow the framework's
+dict-of-arrays convention ({"Wqkv", "bqkv", "Wo", "bo"}) so the layer
+composes with `MultiLayerNetwork`, parameter averaging, and checkpoints like
+any other layer.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nd import random as ndr
+from deeplearning4j_tpu.nn.weights import init_weights
+from deeplearning4j_tpu.nd.attention import (blockwise_attention,
+                                             full_attention)
+
+
+def _dtype(conf):
+    return jnp.dtype(conf.dtype)
+
+
+class MultiHeadAttentionLayer:
+    """Pre-LN multi-head self-attention with residual connection."""
+
+    @staticmethod
+    def init(key, conf):
+        d = _dtype(conf)
+        kq, ko = jax.random.split(key)
+        dist = conf.dist.sampler() if conf.dist is not None else None
+        n = conf.n_in
+        if n % conf.n_heads != 0:
+            raise ValueError(f"n_in={n} not divisible by n_heads={conf.n_heads}")
+        if conf.n_out not in (0, n):
+            raise ValueError(
+                f"attention is residual: n_out must equal n_in={n} (or 0), "
+                f"got {conf.n_out}")
+        return {
+            "Wqkv": init_weights(kq, (n, 3 * n), conf.weight_init, dist, d),
+            "bqkv": jnp.zeros((3 * n,), d),
+            "Wo": init_weights(ko, (n, n), conf.weight_init, dist, d),
+            "bo": jnp.zeros((n,), d),
+            "ln_g": jnp.ones((n,), d),
+            "ln_b": jnp.zeros((n,), d),
+        }
+
+    @staticmethod
+    def forward(params, conf, x, key=None, training=False):
+        b, s, n = x.shape
+        h = conf.n_heads
+        hd = n // h
+        xn = _layer_norm(x, params["ln_g"], params["ln_b"])
+        qkv = xn @ params["Wqkv"] + params["bqkv"]
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q = q.reshape(b, s, h, hd)
+        k = k.reshape(b, s, h, hd)
+        v = v.reshape(b, s, h, hd)
+        blk = conf.attention_block_size
+        if blk and blk > 0:
+            o = blockwise_attention(q, k, v, block_size=blk, causal=conf.causal)
+        else:
+            o = full_attention(q, k, v, causal=conf.causal)
+        o = o.reshape(b, s, n) @ params["Wo"] + params["bo"]
+        if training and conf.dropout > 0.0 and key is not None:
+            o = o * ndr.dropout_mask(key, 1.0 - conf.dropout, o.shape, o.dtype)
+        return x + o
+
+
+def _layer_norm(x, g, b, eps: float = 1e-5):
+    mu = jnp.mean(x, axis=-1, keepdims=True)
+    var = jnp.var(x, axis=-1, keepdims=True)
+    return (x - mu) * jax.lax.rsqrt(var + eps) * g + b

@@ -1,0 +1,128 @@
+"""Sequence/context parallelism: ring and Ulysses (all-to-all) attention.
+
+New-scope capability (SURVEY.md §2 parallelism census: the 2015 reference has
+no attention and no sequence parallelism).  TPU-native long-context story:
+
+- `ring_attention` — context parallelism over a mesh axis: Q/K/V are
+  sequence-sharded, K/V blocks rotate around the ring via `lax.ppermute`
+  (ICI neighbor exchange) while each device accumulates its Q-shard's online
+  softmax.  Compute overlaps with the rotation; memory per chip is O(S/n).
+- `ulysses_attention` — all-to-all sequence parallelism: reshard
+  (seq-sharded -> head-sharded) with `lax.all_to_all`, run full attention on
+  whole sequences locally, reshard back.  Best when heads >= mesh axis size.
+
+Single-chip primitives (`full_attention`, `blockwise_attention`) live in
+`nd/attention.py` and are re-exported here.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from deeplearning4j_tpu.nd.attention import (  # noqa: F401  (re-export)
+    _NEG_BIG, _finalize, _online_update, blockwise_attention, full_attention)
+
+try:
+    from jax import shard_map as _shard_map_impl
+except ImportError:  # older jax
+    from jax.experimental.shard_map import shard_map as _shard_map_impl
+
+
+def _shard_map(f, mesh, in_specs, out_specs):
+    """shard_map with the varying-manual-axes check disabled (the ring carry
+    mixes axis-varying ppermute outputs with invariant init values, which the
+    v0.8 `check_vma` pass rejects; kwarg name differs across jax versions)."""
+    for kw in ({"check_vma": False}, {"check_rep": False}, {}):
+        try:
+            return _shard_map_impl(f, mesh=mesh, in_specs=in_specs,
+                                   out_specs=out_specs, **kw)
+        except TypeError:
+            continue
+    raise RuntimeError("no compatible shard_map signature found")
+
+
+def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, mesh: Mesh,
+                   axis: str = "sp", causal: bool = False) -> jax.Array:
+    """Ring attention over sequence-sharded Q/K/V.
+
+    Each device holds S/n of the sequence.  K/V shards rotate around the
+    `axis` ring via `lax.ppermute` (neighbor ICI hops); each device folds
+    every visiting block into its Q-shard's online softmax.  Causal masking
+    uses global positions, and fully-future blocks are skipped via
+    `lax.cond` so the causal ring does ~half the FLOPs.
+    """
+    n = mesh.shape[axis]
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def local(qs, ks, vs):
+        ai = lax.axis_index(axis)
+        b, s_loc, h, d = qs.shape
+        q_off = ai * s_loc
+
+        def body(r, carry):
+            kc, vc, o, m, l = carry
+            src = jnp.mod(ai - r, n)
+            k_off = src * s_loc
+
+            def attend(oml):
+                return _online_update(oml[0], oml[1], oml[2], qs, kc, vc,
+                                      q_off=q_off, k_off=k_off, causal=causal)
+
+            if causal:
+                # a block strictly in our future contributes nothing
+                o, m, l = lax.cond(src > ai, lambda oml: oml, attend, (o, m, l))
+            else:
+                o, m, l = attend((o, m, l))
+            kc = lax.ppermute(kc, axis, perm)
+            vc = lax.ppermute(vc, axis, perm)
+            return kc, vc, o, m, l
+
+        o0 = jnp.zeros_like(qs)
+        m0 = jnp.full((b, h, s_loc), _NEG_BIG, qs.dtype)
+        l0 = jnp.zeros((b, h, s_loc), qs.dtype)
+        _, _, o, m, l = lax.fori_loop(0, n, body, (ks, vs, o0, m0, l0))
+        return _finalize(o, l)
+
+    spec = P(None, axis, None, None)
+    return _shard_map(local, mesh, (spec, spec, spec), spec)(q, k, v)
+
+
+def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array, mesh: Mesh,
+                      axis: str = "sp", causal: bool = False) -> jax.Array:
+    """All-to-all (DeepSpeed-Ulysses style) sequence parallelism.
+
+    Reshard seq-sharded -> head-sharded with one `all_to_all`, run full
+    attention over the complete sequence locally, reshard back.  Requires
+    heads % axis_size == 0.
+    """
+    n = mesh.shape[axis]
+    if q.shape[2] % n != 0:
+        raise ValueError(f"heads ({q.shape[2]}) not divisible by {axis}={n}")
+
+    def local(qs, ks, vs):
+        # [B, S/n, H, D] -> [B, S, H/n, D]
+        def fwd(x):
+            return lax.all_to_all(x, axis, split_axis=2, concat_axis=1,
+                                  tiled=True)
+
+        def bwd(x):
+            return lax.all_to_all(x, axis, split_axis=1, concat_axis=2,
+                                  tiled=True)
+
+        o = full_attention(fwd(qs), fwd(ks), fwd(vs), causal=causal)
+        return bwd(o)
+
+    spec = P(None, axis, None, None)
+    return _shard_map(local, mesh, (spec, spec, spec), spec)(q, k, v)
+
+
+def make_context_parallel_attention(mesh: Mesh, axis: str = "sp",
+                                    kind: str = "ring", causal: bool = False):
+    """Jitted attention closure over a fixed mesh: kind in {ring, ulysses}."""
+    fn = {"ring": ring_attention, "ulysses": ulysses_attention}[kind]
+    return jax.jit(functools.partial(fn, mesh=mesh, axis=axis, causal=causal))
