@@ -1,0 +1,258 @@
+"""Pallas TPU kernels for the framework's hot ops.
+
+The reference delegates its hot loops to external native BLAS (SURVEY.md §2
+row 1: ND4J jblas/jcublas — e.g. LSTM gates `LSTM.java:161-228`, word2vec
+`InMemoryLookupTable.iterateSample` BLAS dot/axpy at :198-260).  Here the
+equivalent native layer is XLA plus these hand-written Pallas kernels for the
+ops where fusion control matters:
+
+- `flash_attention`     — tiled online-softmax attention entirely in VMEM
+                          (one pass over KV per Q tile; no [S,S] matrix in HBM).
+- `fused_lstm_step`     — one LSTM cell update: both matmuls on the MXU plus
+                          all gate nonlinearities and the state update fused
+                          into a single kernel (one HBM round-trip).
+- `scatter_add_rows`    — embedding-row scatter-add (the word2vec/GloVe
+                          update) using scalar-prefetch block indexing, the
+                          TPU replacement for HogWild row axpy.
+
+Every entry point auto-falls back to interpreter mode off-TPU so the same
+code path is exercised by the CPU test suite (`interpret=None` -> detect).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from deeplearning4j_tpu.nd.attention import blockwise_attention
+
+_NEG_BIG = -1e30
+
+
+def _interpret(flag: Optional[bool]) -> bool:
+    if flag is not None:
+        return flag
+    return jax.devices()[0].platform != "tpu"
+
+
+# ---------------------------------------------------------------- attention
+
+def _flash_attn_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int,
+                       causal: bool, q_block: int, scale: float):
+    """One Q tile vs all KV tiles, online softmax in VMEM.
+
+    q_ref: [block_q, D]; k_ref/v_ref: [S, D]; o_ref: [block_q, D].
+    Grid: (BH, num_q_blocks) — batch*heads is grid dim 0.
+    """
+    qi = pl.program_id(1)
+    s_total = k_ref.shape[0]
+    d = q_ref.shape[1]
+    nk = s_total // block_k
+
+    q = q_ref[:] * scale
+
+    def body(j, carry):
+        o, m, l = carry
+        k = k_ref[pl.ds(j * block_k, block_k), :]
+        v = v_ref[pl.ds(j * block_k, block_k), :]
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32)
+        if causal:
+            qpos = qi * q_block + lax.broadcasted_iota(
+                jnp.int32, (q_block, block_k), 0)
+            kpos = j * block_k + lax.broadcasted_iota(
+                jnp.int32, (q_block, block_k), 1)
+            s = jnp.where(kpos <= qpos, s, _NEG_BIG)
+        m_new = jnp.maximum(m, jnp.max(s, axis=1, keepdims=True))
+        alpha = jnp.exp(m - m_new)
+        p = jnp.exp(s - m_new)
+        l_new = l * alpha + jnp.sum(p, axis=1, keepdims=True)
+        o_new = o * alpha + jnp.dot(p.astype(v.dtype), v,
+                                    preferred_element_type=jnp.float32)
+        return o_new, m_new, l_new
+
+    o0 = jnp.zeros((q_block, d), jnp.float32)
+    m0 = jnp.full((q_block, 1), _NEG_BIG, jnp.float32)
+    l0 = jnp.zeros((q_block, 1), jnp.float32)
+    if causal:
+        # tiles strictly after this q tile's last row contribute nothing
+        nk_needed = lax.min(((qi + 1) * q_block + block_k - 1) // block_k,
+                            nk)
+    else:
+        nk_needed = nk
+    o, m, l = lax.fori_loop(0, nk_needed, body, (o0, m0, l0))
+    o_ref[:] = (o / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+
+
+def _flash_attention_fwd_impl(q, k, v, causal: bool, block_q: int,
+                              block_k: int, interpret: Optional[bool]):
+    b, s, h, d = q.shape
+    bh = b * h
+    # [B,S,H,D] -> [BH,S,D]
+    qr = q.transpose(0, 2, 1, 3).reshape(bh, s, d)
+    kr = k.transpose(0, 2, 1, 3).reshape(bh, s, d)
+    vr = v.transpose(0, 2, 1, 3).reshape(bh, s, d)
+    block_q = min(block_q, s)
+    block_k = min(block_k, s)
+    if s % block_q or s % block_k:
+        # ragged sequence: stay on the jax-level blockwise path
+        return blockwise_attention(q, k, v, block_size=block_k, causal=causal)
+    grid = (bh, s // block_q)
+    scale = 1.0 / (d ** 0.5)
+    kernel = functools.partial(_flash_attn_kernel, block_k=block_k,
+                               causal=causal, q_block=block_q, scale=scale)
+    out = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((bh, s, d), q.dtype),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((None, block_q, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((None, s, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((None, s, d), lambda i, j: (i, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((None, block_q, d), lambda i, j: (i, j, 0)),
+        interpret=_interpret(interpret),
+    )(qr, kr, vr)
+    return out.reshape(b, h, s, d).transpose(0, 2, 1, 3)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def flash_attention(q, k, v, causal: bool = False, block_q: int = 128,
+                    block_k: int = 128, interpret: Optional[bool] = None):
+    """Flash attention: [B,S,H,D] inputs, Pallas forward, recompute backward.
+
+    Backward recomputes attention blockwise (flash-style memory profile) via
+    the jax-level implementation's VJP, so grads never materialize [S,S]
+    either.
+    """
+    return _flash_attention_fwd_impl(q, k, v, causal, block_q, block_k,
+                                     interpret)
+
+
+def _flash_fwd(q, k, v, causal, block_q, block_k, interpret):
+    out = _flash_attention_fwd_impl(q, k, v, causal, block_q, block_k,
+                                    interpret)
+    return out, (q, k, v)
+
+
+def _flash_bwd(causal, block_q, block_k, interpret, res, g):
+    q, k, v = res
+    _, vjp = jax.vjp(
+        lambda q, k, v: blockwise_attention(q, k, v, block_size=block_k,
+                                            causal=causal), q, k, v)
+    return vjp(g)
+
+
+flash_attention.defvjp(_flash_fwd, _flash_bwd)
+
+
+# ---------------------------------------------------------------- LSTM cell
+
+def _lstm_cell_kernel(x_ref, h_ref, c_ref, wx_ref, wh_ref, b_ref,
+                      h_out_ref, c_out_ref):
+    """Fused LSTM cell: gates = x@Wx + h@Wh + b; standard ifgo update.
+
+    Gate layout along the 4H axis: [i | f | g | o] (fused single matmul —
+    the TPU analog of the reference's concatenated iFog weight matrix,
+    `LSTM.java:161-228`).
+    """
+    hdim = h_ref.shape[1]
+    z = (jnp.dot(x_ref[:], wx_ref[:], preferred_element_type=jnp.float32)
+         + jnp.dot(h_ref[:], wh_ref[:], preferred_element_type=jnp.float32)
+         + b_ref[:])
+    i = jax.nn.sigmoid(z[:, 0 * hdim:1 * hdim])
+    f = jax.nn.sigmoid(z[:, 1 * hdim:2 * hdim])
+    g = jnp.tanh(z[:, 2 * hdim:3 * hdim])
+    o = jax.nn.sigmoid(z[:, 3 * hdim:4 * hdim])
+    c_new = f * c_ref[:] + i * g
+    h_out_ref[:] = (o * jnp.tanh(c_new)).astype(h_out_ref.dtype)
+    c_out_ref[:] = c_new.astype(c_out_ref.dtype)
+
+
+def fused_lstm_step(x, h, c, wx, wh, b, interpret: Optional[bool] = None):
+    """One fused LSTM cell update.  x:[B,I] h,c:[B,H] wx:[I,4H] wh:[H,4H]
+    b:[4H] -> (h_new, c_new)."""
+    bsz, hdim = h.shape
+    out_shape = (jax.ShapeDtypeStruct((bsz, hdim), h.dtype),
+                 jax.ShapeDtypeStruct((bsz, hdim), c.dtype))
+    return pl.pallas_call(
+        _lstm_cell_kernel,
+        out_shape=out_shape,
+        interpret=_interpret(interpret),
+    )(x, h, c, wx, wh, b[None, :])
+
+
+# ------------------------------------------------------------- scatter-add
+
+_SCATTER_GROUP = 8  # update rows per grid step (sublane tile height)
+
+
+def _scatter_add_kernel(idx_ref, upd_ref, tbl_ref, out_ref, scratch, sem):
+    """Serial read-modify-write of table rows via manual HBM<->VMEM DMA.
+
+    The table stays in HBM (arbitrary row indices can't be block-mapped
+    under TPU tiling rules); each update row DMAs its destination row into
+    VMEM scratch, accumulates, and DMAs back.  Grid steps run serially on
+    the core, so duplicate indices accumulate correctly.
+    """
+    del tbl_ref  # alias source for out_ref; never read directly
+    g = pl.program_id(0)
+
+    def body(r, _):
+        row = idx_ref[g * _SCATTER_GROUP + r]
+        dst = out_ref.at[pl.ds(row, 1), :]
+        cin = pltpu.make_async_copy(dst, scratch.at[pl.ds(0, 1), :], sem)
+        cin.start()
+        cin.wait()
+        scratch[pl.ds(0, 1), :] += upd_ref[pl.ds(r, 1), :]
+        cout = pltpu.make_async_copy(scratch.at[pl.ds(0, 1), :], dst, sem)
+        cout.start()
+        cout.wait()
+        return 0
+
+    lax.fori_loop(0, _SCATTER_GROUP, body, 0)
+
+
+def scatter_add_rows(table, indices, updates,
+                     interpret: Optional[bool] = None):
+    """table[indices[n]] += updates[n] with duplicate indices accumulating.
+
+    The TPU-native replacement for the reference's HogWild per-row
+    `axpy` embedding updates (`InMemoryLookupTable.java:198-260`).
+    """
+    n, d = updates.shape
+    pad = (-n) % _SCATTER_GROUP
+    if pad:
+        # padded rows add zeros to row 0 — a no-op
+        indices = jnp.concatenate([indices.astype(jnp.int32),
+                                   jnp.zeros((pad,), jnp.int32)])
+        updates = jnp.concatenate(
+            [updates, jnp.zeros((pad, d), updates.dtype)])
+    n_pad = n + pad
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(n_pad // _SCATTER_GROUP,),
+        in_specs=[
+            pl.BlockSpec((_SCATTER_GROUP, d),
+                         lambda g, idx_ref: (g, 0)),
+            pl.BlockSpec(memory_space=pltpu.ANY),
+        ],
+        out_specs=pl.BlockSpec(memory_space=pltpu.ANY),
+        scratch_shapes=[
+            pltpu.VMEM((_SCATTER_GROUP, d), table.dtype),
+            pltpu.SemaphoreType.DMA,
+        ],
+    )
+    return pl.pallas_call(
+        _scatter_add_kernel,
+        out_shape=jax.ShapeDtypeStruct(table.shape, table.dtype),
+        grid_spec=grid_spec,
+        input_output_aliases={2: 0},
+        compiler_params=pltpu.CompilerParams(has_side_effects=True),
+        interpret=_interpret(interpret),
+    )(indices.astype(jnp.int32), updates, table)

@@ -146,6 +146,7 @@ class NeuralNetConfiguration:
     n_heads: int = 4
     causal: bool = False
     attention_block_size: int = 0  # 0 = full attention; >0 = blockwise/flash
+    attention_impl: str = "auto"   # auto | full | blockwise | flash (pallas)
 
     # conv knobs (NCHW)
     kernel_size: Tuple[int, int] = (5, 5)

@@ -60,8 +60,18 @@ class MultiHeadAttentionLayer:
         k = k.reshape(b, s, h, hd)
         v = v.reshape(b, s, h, hd)
         blk = conf.attention_block_size
-        if blk and blk > 0:
-            o = blockwise_attention(q, k, v, block_size=blk, causal=conf.causal)
+        impl = conf.attention_impl
+        if impl == "auto":
+            if jax.devices()[0].platform == "tpu":
+                impl = "flash"
+            else:
+                impl = "blockwise" if blk else "full"
+        if impl == "flash":
+            from deeplearning4j_tpu.nd.pallas_kernels import flash_attention
+            o = flash_attention(q, k, v, conf.causal, blk or 128, blk or 128)
+        elif impl == "blockwise":
+            o = blockwise_attention(q, k, v, block_size=blk or 512,
+                                    causal=conf.causal)
         else:
             o = full_attention(q, k, v, causal=conf.causal)
         o = o.reshape(b, s, n) @ params["Wo"] + params["bo"]
