@@ -116,6 +116,11 @@ class CSVDataFetcher(BaseDataFetcher):
         self.n_classes = n_classes
 
     def fetch(self, num_examples: int = int(1e9)) -> DataSet:
+        from deeplearning4j_tpu.native import native_read_csv
+        arr = native_read_csv(self.path, skip_header=self.skip_header)
+        if arr is not None:
+            arr = arr[:num_examples].astype(np.float32)
+            return self._to_dataset(arr)
         rows = []
         with open(self.path, newline="") as f:
             reader = csv_mod.reader(f)
@@ -127,7 +132,9 @@ class CSVDataFetcher(BaseDataFetcher):
                 rows.append([float(v) for v in row])
                 if len(rows) >= num_examples:
                     break
-        arr = np.asarray(rows, np.float32)
+        return self._to_dataset(np.asarray(rows, np.float32))
+
+    def _to_dataset(self, arr: np.ndarray) -> DataSet:
         lc = self.label_column % arr.shape[1]
         y = arr[:, lc].astype(np.int64)
         X = np.delete(arr, lc, axis=1)

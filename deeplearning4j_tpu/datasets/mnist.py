@@ -37,7 +37,16 @@ def _open(path: str):
 
 
 def read_idx(path: str) -> np.ndarray:
-    """Parse an IDX file (images or labels) into a numpy array."""
+    """Parse an IDX file (images or labels) into a numpy array.
+
+    Uses the native C++ parser (`native/dataloader.cc`) when the library is
+    available and the file is uncompressed; falls back to the Python path
+    (which also handles .gz)."""
+    if os.path.exists(path):  # native path can't see through .gz
+        from deeplearning4j_tpu.native import native_read_idx
+        arr = native_read_idx(path)
+        if arr is not None:
+            return arr
     with _open(path) as f:
         magic = struct.unpack(">I", f.read(4))[0]
         ndim = magic & 0xFF

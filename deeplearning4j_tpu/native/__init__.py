@@ -1,0 +1,122 @@
+"""Native (C++) host-side components, loaded via ctypes.
+
+The compute path is XLA/Pallas; this package holds the host-runtime pieces
+that the reference implements natively-adjacent (Java streams over IDX/CSV:
+`MnistManager.java`, `CSVDataFetcher`) and that a real input pipeline wants
+off the Python interpreter.  The shared library is built on first use with
+g++ (cached next to the sources); every caller has a pure-Python fallback,
+so the framework works identically without a toolchain.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+import numpy as np
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "dataloader.cc")
+_LIB = os.path.join(_DIR, "libdl4jtpu_io.so")
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_load_failed = False
+
+
+def _build() -> bool:
+    cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-pthread",
+           "-o", _LIB, _SRC]
+    try:
+        res = subprocess.run(cmd, capture_output=True, timeout=120)
+        return res.returncode == 0 and os.path.exists(_LIB)
+    except (OSError, subprocess.TimeoutExpired):
+        return False
+
+
+def get_library() -> Optional[ctypes.CDLL]:
+    """The IO library, building it if needed; None when unavailable."""
+    global _lib, _load_failed
+    if _lib is not None or _load_failed:
+        return _lib
+    with _lock:
+        if _lib is not None or _load_failed:
+            return _lib
+        if os.environ.get("DL4J_TPU_NO_NATIVE"):
+            _load_failed = True
+            return None
+        if not os.path.exists(_LIB) or (
+                os.path.exists(_SRC)
+                and os.path.getmtime(_SRC) > os.path.getmtime(_LIB)):
+            if not _build():
+                _load_failed = True
+                return None
+        try:
+            lib = ctypes.CDLL(_LIB)
+        except OSError:
+            _load_failed = True
+            return None
+        lib.dl4j_idx_header.restype = ctypes.c_int
+        lib.dl4j_idx_header.argtypes = [
+            ctypes.c_char_p, ctypes.POINTER(ctypes.c_int),
+            ctypes.POINTER(ctypes.c_int64)]
+        lib.dl4j_idx_read.restype = ctypes.c_int64
+        lib.dl4j_idx_read.argtypes = [
+            ctypes.c_char_p, ctypes.POINTER(ctypes.c_uint8), ctypes.c_int64]
+        lib.dl4j_csv_dims.restype = ctypes.c_int
+        lib.dl4j_csv_dims.argtypes = [
+            ctypes.c_char_p, ctypes.c_int, ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_int64)]
+        lib.dl4j_csv_read.restype = ctypes.c_int
+        lib.dl4j_csv_read.argtypes = [
+            ctypes.c_char_p, ctypes.c_int, ctypes.POINTER(ctypes.c_float),
+            ctypes.c_int64, ctypes.c_int64, ctypes.c_int]
+        _lib = lib
+        return _lib
+
+
+def native_read_idx(path: str) -> Optional[np.ndarray]:
+    """IDX file -> uint8 ndarray via the native parser; None if unavailable
+    or unsupported (e.g. gzipped or non-u8 dtype)."""
+    lib = get_library()
+    if lib is None or not os.path.exists(path):
+        return None
+    ndim = ctypes.c_int(0)
+    dims = (ctypes.c_int64 * 8)()
+    dtype = lib.dl4j_idx_header(path.encode(), ctypes.byref(ndim), dims)
+    if dtype != 0x08:
+        return None
+    shape = tuple(dims[i] for i in range(ndim.value))
+    out = np.empty(shape, np.uint8)
+    got = lib.dl4j_idx_read(
+        path.encode(), out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        out.size)
+    if got != out.size:
+        return None
+    return out
+
+
+def native_read_csv(path: str, skip_header: bool = False,
+                    nthreads: int = 0) -> Optional[np.ndarray]:
+    """Numeric CSV -> float32 [rows, cols] via the parallel native parser;
+    None if unavailable or the file has non-numeric fields."""
+    lib = get_library()
+    if lib is None or not os.path.exists(path):
+        return None
+    rows = ctypes.c_int64(0)
+    cols = ctypes.c_int64(0)
+    rc = lib.dl4j_csv_dims(path.encode(), int(skip_header),
+                           ctypes.byref(rows), ctypes.byref(cols))
+    if rc != 0 or rows.value == 0 or cols.value == 0:
+        return None
+    out = np.empty((rows.value, cols.value), np.float32)
+    rc = lib.dl4j_csv_read(
+        path.encode(), int(skip_header),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        rows.value, cols.value, nthreads)
+    if rc != 0:
+        return None
+    return out
