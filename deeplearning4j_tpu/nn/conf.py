@@ -130,6 +130,8 @@ class NeuralNetConfiguration:
     step_function: str = "default"
     num_line_search_iterations: int = 20
     lbfgs_memory: int = 4          # two-loop history (LBFGS.java m=4)
+    hf_cg_iterations: int = 32     # inner CG trip count (Martens HF)
+    hf_initial_lambda: float = 1.0  # initial LM damping (HF)
 
     # stochastic regularization
     dropout: float = 0.0

@@ -28,7 +28,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax.flatten_util import ravel_pytree
 
-from deeplearning4j_tpu.nn.conf import LayerType, MultiLayerConfiguration
+from deeplearning4j_tpu.nn.conf import (LayerType, MultiLayerConfiguration,
+                                        OptimizationAlgorithm)
 from deeplearning4j_tpu.nn.layers import get_layer
 from deeplearning4j_tpu.nn.layers.preprocessor import apply_preprocessor
 from deeplearning4j_tpu.optimize import solver as solver_mod
@@ -139,7 +140,23 @@ class MultiLayerNetwork:
         def loss(params, key):
             return network_loss(conf, params, x, labels, key, training=True)
 
-        return solver_mod.from_loss(loss)
+        objective = solver_mod.from_loss(loss)
+        out_conf = conf.conf(conf.n_layers - 1)
+        if OptimizationAlgorithm(str(out_conf.optimization_algo)) == \
+                OptimizationAlgorithm.HESSIAN_FREE:
+            # factor as predict+loss so HF gets Gauss-Newton products
+            # (reference: computeDeltasR/feedForwardR R-op machinery,
+            # MultiLayerNetwork.java:554-627,1407-1479)
+            from deeplearning4j_tpu.nd.losses import get_loss
+            loss_fn = get_loss(out_conf.loss_function)
+
+            def predict(params, key):
+                return network_output(conf, params, x)
+
+            objective = objective._replace(
+                gnvp=solver_mod.from_predict_loss(
+                    predict, lambda z: loss_fn(labels, z)).gnvp)
+        return objective
 
     def pretrain_layer(self, i: int, x) -> None:
         """Optimize layer i's unsupervised objective on its own inputs."""

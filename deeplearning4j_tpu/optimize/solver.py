@@ -6,15 +6,20 @@ search -> listeners -> termination), `ConjugateGradient.java:47-122`
 (Polak-Ribiere), `LBFGS.java:152-266` (two-loop recursion, m=4),
 `GradientAscent.java` (line-searched descent),
 `IterationGradientDescent.java` (plain stepped descent), terminations
-(`EpsTermination`/`Norm2Termination`/`ZeroDirection`).
+(`EpsTermination`/`Norm2Termination`/`ZeroDirection`), and
+`StochasticHessianFree.java:44-262` (Martens HF: Gauss-Newton products via
+the R-operator + damped inner CG — the reference pairs it with
+`MultiLayerNetwork.computeDeltasR/feedForwardR` at
+`MultiLayerNetwork.java:554-627,1407-1479`).
 
 TPU-native design: each solver is ONE jit-compiled `lax.scan` over a fixed
 iteration count with a carried `done` flag implementing the reference's
 data-dependent termination conditions (XLA needs static trip counts; a
 tripped termination masks further updates).  Flat-vector algebra via
 `ravel_pytree`; inner Armijo line search via `linesearch.backtrack`.
-Hessian-free falls back to conjugate gradient this round (HF = CG on a
-Gauss-Newton model; full R-op HF is tracked as future work).
+Hessian-free replaces the reference's hand-written R-op machinery with
+jvp-over-grad (exact HVP) or jvp->loss-Hessian->vjp (Gauss-Newton, when the
+objective factors as predict+loss), plus Levenberg-Marquardt damping.
 """
 
 from __future__ import annotations
@@ -38,10 +43,14 @@ class Objective(NamedTuple):
 
     grad_and_score(params, key) -> (grads_pytree, scalar_score)
     score(params, key) -> scalar_score
+    gnvp (optional): (params, v_pytree, key) -> pytree — Gauss-Newton
+        curvature-vector product for Hessian-free; when absent HF uses the
+        exact Hessian-vector product (jvp of the gradient).
     """
 
     grad_and_score: Callable
     score: Callable
+    gnvp: Optional[Callable] = None
 
 
 def from_loss(loss_fn: Callable) -> Objective:
@@ -52,6 +61,27 @@ def from_loss(loss_fn: Callable) -> Objective:
         return g, s
 
     return Objective(grad_and_score=gs, score=loss_fn)
+
+
+def from_predict_loss(predict: Callable, loss_of_out: Callable) -> Objective:
+    """Objective from `predict(params, key) -> outputs` and
+    `loss_of_out(outputs) -> scalar`, with a Gauss-Newton product
+    G v = J^T (H_loss (J v)) — the TPU replacement for the reference's
+    R-operator machinery (`StochasticHessianFree.java:89-262`)."""
+
+    def loss_fn(params, key):
+        return loss_of_out(predict(params, key))
+
+    def gs(params, key):
+        s, g = jax.value_and_grad(loss_fn)(params, key)
+        return g, s
+
+    def gnvp(params, v, key):
+        z, jz = jax.jvp(lambda p: predict(p, key), (params,), (v,))
+        hl_jz = jax.jvp(jax.grad(loss_of_out), (z,), (jz,))[1]
+        return jax.vjp(lambda p: predict(p, key), params)[1](hl_jz)[0]
+
+    return Objective(grad_and_score=gs, score=loss_fn, gnvp=gnvp)
 
 
 def _terminated(score, old_score, gnorm):
@@ -101,8 +131,7 @@ def _line_searched(objective: Objective, params0, conf, key, algo):
         g, s = objective.grad_and_score(unravel(x), k)
         return ravel_pytree(g)[0], s
 
-    is_cg = algo in (OptimizationAlgorithm.CONJUGATE_GRADIENT,
-                     OptimizationAlgorithm.HESSIAN_FREE)
+    is_cg = algo == OptimizationAlgorithm.CONJUGATE_GRADIENT
     is_lbfgs = algo == OptimizationAlgorithm.LBFGS
 
     def step(carry, it):
@@ -197,6 +226,87 @@ def _line_searched(objective: Objective, params0, conf, key, algo):
     return unravel(xf), scores
 
 
+def _hessian_free(objective: Objective, params0, conf, key):
+    """Martens Hessian-free: damped inner CG on curvature-vector products.
+
+    Parity: `StochasticHessianFree.java:44-262` — Gauss-Newton products
+    (via `Objective.gnvp` when available, else exact HVP by jvp-over-grad),
+    CG warm-started from the previous solution (decayed), and
+    Levenberg-Marquardt lambda adaptation from the reduction ratio rho.
+    """
+    x0, unravel = ravel_pytree(params0)
+
+    def grad_flat(x, k):
+        g, s = objective.grad_and_score(unravel(x), k)
+        return ravel_pytree(g)[0], s
+
+    def score_flat(x, k):
+        return objective.score(unravel(x), k)
+
+    def bvp(x, v, lam, k):
+        """Damped curvature-vector product (B + lam I) v."""
+        if objective.gnvp is not None:
+            hv = ravel_pytree(objective.gnvp(unravel(x), unravel(v), k))[0]
+        else:
+            hv = jax.jvp(lambda xx: grad_flat(xx, k)[0], (x,), (v,))[1]
+        return hv + lam * v
+
+    cg_iters = conf.hf_cg_iterations
+
+    def cg_solve(x, g, lam, d0, k):
+        """CG on (B + lam I) d = -g, warm start d0; fixed trip count with a
+        converged mask (static shapes for XLA)."""
+
+        def mv(v):
+            return bvp(x, v, lam, k)
+
+        r0 = -g - mv(d0)
+        rs0 = jnp.vdot(r0, r0)
+
+        def body(carry, _):
+            d, r, p, rs = carry
+            ap = mv(p)
+            denom = jnp.vdot(p, ap)
+            live = jnp.logical_and(rs > 1e-16, denom > 1e-20)
+            alpha = jnp.where(live, rs / jnp.where(denom == 0, 1.0, denom), 0.0)
+            d = d + alpha * p
+            r = r - alpha * ap
+            rs_new = jnp.vdot(r, r)
+            beta = jnp.where(live, rs_new / jnp.where(rs == 0, 1.0, rs), 0.0)
+            p = jnp.where(live, r + beta * p, p)
+            return (d, r, p, jnp.where(live, rs_new, rs)), None
+
+        (d, *_), _ = jax.lax.scan(body, (d0, r0, r0, rs0), None,
+                                  length=cg_iters)
+        return d
+
+    def step(carry, it):
+        x, d_prev, lam, k, done, old_score = carry
+        k, kg = jax.random.split(k)
+        g, score = grad_flat(x, kg)
+        gnorm = jnp.linalg.norm(g)
+        d = cg_solve(x, g, lam, 0.95 * d_prev, kg)
+        # quadratic-model reduction for the LM rho test
+        qm = jnp.vdot(g, d) + 0.5 * jnp.vdot(d, bvp(x, d, lam, kg))
+        new_score = score_flat(x + d, kg)
+        rho = (new_score - score) / jnp.where(qm >= 0, -1e-10, qm)
+        lam = jnp.where(rho > 0.75, lam * (2.0 / 3.0),
+                        jnp.where(rho < 0.25, lam * 1.5, lam))
+        accept = new_score < score
+        x_new = jnp.where(jnp.logical_or(done, ~accept), x, x + d)
+        d_prev = jnp.where(done, d_prev, d)
+        out_score = jnp.where(jnp.logical_or(done, ~accept), old_score,
+                              new_score)
+        done = jnp.logical_or(done, _terminated(new_score, old_score, gnorm))
+        return (x_new, d_prev, lam, k, done, out_score), out_score
+
+    init = (x0, jnp.zeros_like(x0), jnp.asarray(conf.hf_initial_lambda),
+            key, jnp.asarray(False), jnp.inf)
+    (xf, *_), scores = jax.lax.scan(step, init,
+                                    jnp.arange(conf.num_iterations))
+    return unravel(xf), scores
+
+
 def optimize(objective: Objective, params0, conf, key):
     """Run the configured solver; returns (params, per-iteration scores).
 
@@ -205,6 +315,8 @@ def optimize(objective: Objective, params0, conf, key):
     algo = OptimizationAlgorithm(str(conf.optimization_algo))
     if algo == OptimizationAlgorithm.ITERATION_GRADIENT_DESCENT:
         return _sgd(objective, params0, conf, key)
+    if algo == OptimizationAlgorithm.HESSIAN_FREE:
+        return _hessian_free(objective, params0, conf, key)
     return _line_searched(objective, params0, conf, key, algo)
 
 
