@@ -1,0 +1,88 @@
+"""Cluster provisioning + blob store (AWS-module analog)."""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.scaleout.provision import (BlobDataSetIterator,
+                                                   BlobModelSaver,
+                                                   ClusterSpec,
+                                                   HostProvisioner, HostSpec,
+                                                   LocalBlobStore)
+
+
+def _spec():
+    return ClusterSpec(hosts=[HostSpec("10.0.0.1"), HostSpec("10.0.0.2")],
+                       coordinator_port=9000)
+
+
+def test_cluster_spec_roundtrip_and_env():
+    spec = _spec()
+    spec2 = ClusterSpec.from_json(spec.to_json())
+    assert spec2.coordinator_address == "10.0.0.1:9000"
+    env = spec2.distributed_env(1)
+    assert env["JAX_PROCESS_ID"] == "1"
+    assert env["JAX_NUM_PROCESSES"] == "2"
+    assert env["JAX_COORDINATOR_ADDRESS"] == "10.0.0.1:9000"
+
+
+def test_provisioner_dry_run_generates_commands():
+    prov = HostProvisioner(_spec(), dry_run=True)
+    prov.provision_all("/tmp/framework")
+    prov.launch_workers("python worker.py")
+    rsyncs = [c for c in prov.executed if c[0] == "rsync"]
+    sshes = [c for c in prov.executed if c[0] == "ssh"]
+    assert len(rsyncs) == 2 and len(sshes) == 2
+    # each worker gets its own process id in the env prefix
+    assert "JAX_PROCESS_ID=0" in sshes[0][-1]
+    assert "JAX_PROCESS_ID=1" in sshes[1][-1]
+    assert "JAX_COORDINATOR_ADDRESS=10.0.0.1:9000" in sshes[1][-1]
+
+
+def test_local_blob_store_roundtrip(tmp_path):
+    store = LocalBlobStore(str(tmp_path / "store"))
+    src = tmp_path / "a.txt"
+    src.write_text("hello")
+    store.upload("artifacts/a.txt", str(src))
+    assert store.exists("artifacts/a.txt")
+    assert store.list("artifacts/") == ["artifacts/a.txt"]
+    dst = tmp_path / "b.txt"
+    store.download("artifacts/a.txt", str(dst))
+    assert dst.read_text() == "hello"
+    store.delete("artifacts/a.txt")
+    assert not store.exists("artifacts/a.txt")
+
+
+def test_blob_store_rejects_escaping_keys(tmp_path):
+    store = LocalBlobStore(str(tmp_path / "store"))
+    import pytest
+    with pytest.raises(ValueError, match="escapes"):
+        store.upload("../evil", __file__)
+
+
+def test_blob_model_saver_roundtrip(tmp_path):
+    store = LocalBlobStore(str(tmp_path / "store"))
+    params = ({"W": jnp.arange(6.0).reshape(2, 3)},)
+    saver = BlobModelSaver(store, key="models/mlp")
+    saver.save(params, step=7)
+    restored, updater, meta = saver.load(like_params=params)
+    np.testing.assert_allclose(np.asarray(restored[0]["W"]),
+                               np.arange(6.0).reshape(2, 3))
+    assert updater is None
+    assert meta["step"] == 7
+
+
+def test_blob_dataset_iterator(tmp_path):
+    store = LocalBlobStore(str(tmp_path / "store"))
+    for i in range(3):
+        p = tmp_path / f"part{i}.npz"
+        np.savez(p, features=np.full((4, 2), i, np.float32),
+                 labels=np.eye(4, 3, dtype=np.float32))
+        store.upload(f"data/part{i}.npz", str(p))
+    it = BlobDataSetIterator(store, prefix="data/")
+    parts = list(it)
+    assert len(parts) == 3
+    assert parts[1].features.shape == (4, 2)
+    np.testing.assert_allclose(parts[2].features, 2.0)
