@@ -1,0 +1,169 @@
+"""Profiling, tracing, and metrics — §5 aux-subsystem parity, TPU-native.
+
+The reference's observability is wall-clock job timing
+(`WorkerActor.java:199-203` "Job took X ms"), iteration listeners
+(`ScoreIterationListener.java:43-46`), named counters in the state tracker
+(`StateTracker.increment/count`, `StateTracker.java:54-56`), and the YARN
+`metricsReport(map<string,long>)` RPC (`IterativeReduceService.java:28`).
+
+TPU-native upgrade: the same surface plus real XLA traces via
+`jax.profiler` (start/stop trace + annotations viewable in
+TensorBoard/Perfetto) and a throughput meter that blocks on device results
+so timings measure compute, not dispatch.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import logging
+import threading
+import time
+from typing import Dict, Optional
+
+log = logging.getLogger("deeplearning4j_tpu")
+
+
+class StepTimer:
+    """Wall-clock step timing ("Job took X ms" parity) with summary stats."""
+
+    def __init__(self, name: str = "step", log_each: bool = False):
+        self.name = name
+        self.log_each = log_each
+        self.times_ms = []
+        self._t0: Optional[float] = None
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        dt_ms = (time.perf_counter() - self._t0) * 1e3
+        self.times_ms.append(dt_ms)
+        if self.log_each:
+            log.info("%s took %.2f ms", self.name, dt_ms)
+        return False
+
+    @property
+    def mean_ms(self) -> float:
+        return sum(self.times_ms) / len(self.times_ms) if self.times_ms else 0.0
+
+    def summary(self) -> Dict[str, float]:
+        ts = sorted(self.times_ms)
+        if not ts:
+            return {"count": 0}
+        return {
+            "count": len(ts),
+            "mean_ms": self.mean_ms,
+            "min_ms": ts[0],
+            "p50_ms": ts[len(ts) // 2],
+            "max_ms": ts[-1],
+        }
+
+
+class ThroughputMeter:
+    """samples/sec over device-blocking steps (timings measure compute)."""
+
+    def __init__(self):
+        self.samples = 0
+        self.seconds = 0.0
+
+    @contextlib.contextmanager
+    def measure(self, batch_size: int, result_to_block_on=None):
+        t0 = time.perf_counter()
+        yield
+        if result_to_block_on is not None:
+            import jax
+
+            jax.block_until_ready(result_to_block_on)
+        self.seconds += time.perf_counter() - t0
+        self.samples += batch_size
+
+    @property
+    def samples_per_sec(self) -> float:
+        return self.samples / self.seconds if self.seconds > 0 else 0.0
+
+
+class Tracer:
+    """XLA trace capture (TensorBoard/Perfetto) + named annotations."""
+
+    def __init__(self, trace_dir: str = "/tmp/dl4j_tpu_trace"):
+        self.trace_dir = trace_dir
+        self._active = False
+
+    def start(self) -> None:
+        import jax
+
+        if not self._active:
+            jax.profiler.start_trace(self.trace_dir)
+            self._active = True
+
+    def stop(self) -> None:
+        import jax
+
+        if self._active:
+            jax.profiler.stop_trace()
+            self._active = False
+
+    @contextlib.contextmanager
+    def trace(self):
+        self.start()
+        try:
+            yield self
+        finally:
+            self.stop()
+
+    @staticmethod
+    def annotate(name: str):
+        """Named region visible in the trace viewer."""
+        import jax
+
+        return jax.profiler.TraceAnnotation(name)
+
+
+class MetricsRegistry:
+    """Named counters + gauges (StateTracker.increment / YARN
+    metricsReport parity), thread-safe."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counters: Dict[str, float] = {}
+        self._gauges: Dict[str, float] = {}
+
+    def increment(self, name: str, by: float = 1.0) -> None:
+        with self._lock:
+            self._counters[name] = self._counters.get(name, 0.0) + by
+
+    def count(self, name: str) -> float:
+        with self._lock:
+            return self._counters.get(name, 0.0)
+
+    def gauge(self, name: str, value: float) -> None:
+        with self._lock:
+            self._gauges[name] = value
+
+    def report(self) -> Dict[str, float]:
+        """metricsReport(map<string,long>) parity — one flat dict."""
+        with self._lock:
+            out = dict(self._counters)
+            out.update(self._gauges)
+            return out
+
+
+METRICS = MetricsRegistry()  # process-global default registry
+
+
+class TimingIterationListener:
+    """IterationListener recording inter-iteration wall time into METRICS."""
+
+    def __init__(self, registry: Optional[MetricsRegistry] = None):
+        self.registry = registry or METRICS
+        self._last: Optional[float] = None
+
+    def iteration_done(self, model, iteration: int, score: float) -> None:
+        now = time.perf_counter()
+        if self._last is not None:
+            self.registry.increment("iteration_ms_total",
+                                    (now - self._last) * 1e3)
+        self.registry.increment("iterations")
+        self.registry.gauge("last_score", score)
+        self._last = now

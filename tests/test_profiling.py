@@ -1,0 +1,55 @@
+"""Profiling/metrics aux subsystem."""
+
+import time
+
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.utils.profiling import (MetricsRegistry, StepTimer,
+                                                ThroughputMeter,
+                                                TimingIterationListener,
+                                                Tracer)
+
+
+def test_step_timer_summary():
+    t = StepTimer("job")
+    for _ in range(5):
+        with t:
+            time.sleep(0.002)
+    s = t.summary()
+    assert s["count"] == 5
+    assert s["mean_ms"] >= 1.0
+    assert s["min_ms"] <= s["p50_ms"] <= s["max_ms"]
+
+
+def test_throughput_meter_blocks_on_device():
+    m = ThroughputMeter()
+    x = jnp.ones((64, 64))
+    with m.measure(128, result_to_block_on=x @ x):
+        y = x @ x
+    assert m.samples == 128
+    assert m.samples_per_sec > 0
+
+
+def test_metrics_registry_report():
+    r = MetricsRegistry()
+    r.increment("jobs")
+    r.increment("jobs", 2)
+    r.gauge("loss", 0.5)
+    rep = r.report()
+    assert rep["jobs"] == 3.0
+    assert rep["loss"] == 0.5
+
+
+def test_timing_listener_accumulates():
+    r = MetricsRegistry()
+    lst = TimingIterationListener(r)
+    for i in range(3):
+        lst.iteration_done(None, i, 1.0 - 0.1 * i)
+    rep = r.report()
+    assert rep["iterations"] == 3.0
+    assert rep["last_score"] == 0.8
+
+
+def test_tracer_annotation_usable():
+    with Tracer.annotate("test-region"):
+        _ = jnp.sum(jnp.arange(10))
