@@ -1,0 +1,77 @@
+"""Sentence -> binary parse trees for recursive models (RNTN input).
+
+Capability parity with reference `text/corpora/treeparser/TreeParser.java`
+(+ `TreeVectorizer`, binarization, head-word finding): the reference shells
+out to vendored CRFsuite binaries and UIMA annotators to chunk sentences,
+then binarizes the chunk tree.  Neither native binary exists here, so the
+TPU framework ships hermetic parser strategies with the same output
+contract (binary `TreeNode`s consumable by `models/rntn`):
+
+- "right" / "left": right- or left-branching chains (the standard
+  baseline for recursive nets without a treebank).
+- "balanced": minimum-depth binary tree (better for deep composition).
+
+Labels default to a neutral class; `label_fn(token) -> int` lets callers
+attach sentiment/class labels (the role SentiWordNet plays in the
+reference's pipeline).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, Optional, Sequence
+
+from deeplearning4j_tpu.models.rntn import TreeNode
+from deeplearning4j_tpu.text.tokenization import DefaultTokenizerFactory
+
+
+class TreeParser:
+    def __init__(self, strategy: str = "balanced", n_classes: int = 2,
+                 neutral_label: int = 0,
+                 label_fn: Optional[Callable[[str], int]] = None,
+                 tokenizer_factory=None):
+        if strategy not in ("right", "left", "balanced"):
+            raise ValueError(f"unknown strategy {strategy!r}")
+        self.strategy = strategy
+        self.neutral_label = neutral_label
+        self.label_fn = label_fn or (lambda tok: neutral_label)
+        self.tokenizer_factory = tokenizer_factory or DefaultTokenizerFactory()
+
+    # -- leaves
+    def _leaf(self, tok: str) -> TreeNode:
+        return TreeNode(label=self.label_fn(tok), word=tok)
+
+    def _merge(self, a: TreeNode, b: TreeNode) -> TreeNode:
+        # internal label: propagate the "head" child's label (right child —
+        # simple head rule, TreeParser head-word finding analog)
+        return TreeNode(label=b.label, left=a, right=b)
+
+    def _build(self, leaves: List[TreeNode]) -> TreeNode:
+        if len(leaves) == 1:
+            return leaves[0]
+        if self.strategy == "right":
+            node = leaves[-1]
+            for leaf in reversed(leaves[:-1]):
+                node = self._merge(leaf, node)
+            return node
+        if self.strategy == "left":
+            node = leaves[0]
+            for leaf in leaves[1:]:
+                node = self._merge(node, leaf)
+            return node
+        mid = len(leaves) // 2
+        return self._merge(self._build(leaves[:mid]), self._build(leaves[mid:]))
+
+    # -- public API (TreeParser.getTrees analog)
+    def parse(self, sentence: str) -> Optional[TreeNode]:
+        tokens = self.tokenizer_factory.create(sentence).get_tokens()
+        if not tokens:
+            return None
+        return self._build([self._leaf(t) for t in tokens])
+
+    def get_trees(self, sentences: Sequence[str]) -> List[TreeNode]:
+        out = []
+        for s in sentences:
+            t = self.parse(s)
+            if t is not None:
+                out.append(t)
+        return out

@@ -1,0 +1,49 @@
+"""TreeParser -> RNTN end-to-end (hermetic treebank-path parity)."""
+
+import pytest
+
+from deeplearning4j_tpu.models.rntn import RNTN, tree_tokens
+from deeplearning4j_tpu.text.tree_parser import TreeParser
+
+
+def test_strategies_preserve_token_order():
+    for strategy in ("right", "left", "balanced"):
+        parser = TreeParser(strategy=strategy)
+        t = parser.parse("a b c d e")
+        assert tree_tokens(t) == ["a", "b", "c", "d", "e"], strategy
+
+
+def test_balanced_tree_is_shallow():
+    def depth(t):
+        return 0 if t.is_leaf else 1 + max(depth(t.left), depth(t.right))
+
+    toks = " ".join(f"w{i}" for i in range(16))
+    assert depth(TreeParser("balanced").parse(toks)) == 4
+    assert depth(TreeParser("right").parse(toks)) == 15
+
+
+def test_single_token_and_empty():
+    parser = TreeParser()
+    t = parser.parse("solo")
+    assert t.is_leaf and t.word == "solo"
+    assert parser.parse("   ") is None
+
+
+def test_unknown_strategy_raises():
+    with pytest.raises(ValueError, match="strategy"):
+        TreeParser(strategy="bogus")
+
+
+def test_parser_feeds_rntn_training():
+    pos_words = {"good", "great", "nice", "happy"}
+
+    def label(tok):
+        return 1 if tok in pos_words else 0
+
+    parser = TreeParser(strategy="balanced", label_fn=label)
+    pos = ["good great", "nice good happy", "great happy"]
+    neg = ["bad awful", "poor bad sad", "awful sad"]
+    trees = parser.get_trees(pos) + parser.get_trees(neg)
+    model = RNTN(dim=8, n_classes=2, max_nodes=16, lr=0.1, seed=0)
+    model.fit(trees, epochs=120)
+    assert model.accuracy(trees, root_only=True) >= 5 / 6
