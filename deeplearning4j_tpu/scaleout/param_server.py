@@ -1,0 +1,263 @@
+"""Iterative-reduce parameter server over HTTP (YARN-path parity, #22).
+
+Capability parity with the reference's Avro-RPC parameter server
+(`IterativeReduceService.java:27-45`: startup / progress / update / waiting
+/ fetch / complete / error / metricsReport, driven by
+`ApplicationMaster`/`ApplicationWorker` with `ComputableMaster.compute` =
+parameter averaging, `impl/multilayer/Master.java:41-96`).
+
+TPU-native framing: inside a slice, parameter exchange is XLA collectives
+(`parallel/data_parallel`); this server is the *cross-process/DCN control
+path* for fleets that aren't one jax.distributed job — e.g. elastic CPU
+feeders or federated-style workers.  Protocol carried over plain HTTP with
+npz bodies (no Avro in this image); aggregation is worker-count-gated
+parameter averaging exactly like `Master.compute`.
+
+BSP semantics: `update` banks a worker's vector for round r; once all
+expected workers have banked, the server averages and publishes round r+1;
+`fetch` of a not-yet-published round returns 409 and workers poll —
+the reference's `waiting()` gate.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import threading
+import urllib.request
+import urllib.error
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, List, Optional
+
+import numpy as np
+
+
+def _dumps_npz(arrays: Dict[str, np.ndarray]) -> bytes:
+    buf = io.BytesIO()
+    np.savez(buf, **arrays)
+    return buf.getvalue()
+
+
+def _loads_npz(data: bytes) -> Dict[str, np.ndarray]:
+    with np.load(io.BytesIO(data)) as z:
+        return {k: z[k] for k in z.files}
+
+
+class ParameterServer:
+    """Master side: banks worker updates, averages, publishes rounds."""
+
+    def __init__(self, initial: np.ndarray, n_workers: int,
+                 iterations: int = 1, batch_size: int = 0):
+        self._lock = threading.Lock()
+        self.current = np.asarray(initial)
+        self.n_workers = n_workers
+        self.iterations = iterations
+        self.batch_size = batch_size
+        self.round = 0
+        self.pending: Dict[str, np.ndarray] = {}
+        self.workers: List[str] = []
+        self.completed: set = set()
+        self.errors: Dict[str, str] = {}
+        self.metrics: Dict[str, float] = {}
+        self.progress: Dict[str, dict] = {}
+        self._server: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    # ---- protocol ops (IterativeReduceService methods)
+    def startup(self, worker_id: str) -> dict:
+        with self._lock:
+            if worker_id not in self.workers:
+                self.workers.append(worker_id)
+            split = self.workers.index(worker_id)
+        return {"worker_id": worker_id, "split_index": split,
+                "total_splits": self.n_workers,
+                "iterations": self.iterations,
+                "batch_size": self.batch_size}
+
+    def update(self, worker_id: str, vec: np.ndarray) -> dict:
+        with self._lock:
+            self.pending[worker_id] = np.asarray(vec)
+            if len(self.pending) >= self.n_workers:
+                # ComputableMaster.compute: average all worker vectors
+                self.current = np.mean(list(self.pending.values()), axis=0)
+                self.pending.clear()
+                self.round += 1
+            return {"round": self.round}
+
+    def waiting(self) -> dict:
+        with self._lock:
+            return {"banked": len(self.pending), "round": self.round,
+                    "workers": len(self.workers)}
+
+    def fetch(self, update_id: int):
+        with self._lock:
+            if update_id > self.round:
+                return None  # not published yet -> caller polls
+            return self.current
+
+    def complete(self, worker_id: str) -> dict:
+        with self._lock:
+            self.completed.add(worker_id)
+            return {"done": len(self.completed) >= self.n_workers}
+
+    def error(self, worker_id: str, msg: str) -> None:
+        with self._lock:
+            self.errors[worker_id] = msg
+
+    def metrics_report(self, report: Dict[str, float]) -> None:
+        with self._lock:
+            for k, v in report.items():
+                self.metrics[k] = self.metrics.get(k, 0.0) + float(v)
+
+    # ---- HTTP plumbing
+    def serve(self, port: int = 0) -> int:
+        ps = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def _json(self, obj, code=200):
+                body = json.dumps(obj).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _bytes(self, data: bytes, code=200):
+                self.send_response(code)
+                self.send_header("Content-Type", "application/octet-stream")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def _body(self) -> bytes:
+                n = int(self.headers.get("Content-Length", "0"))
+                return self.rfile.read(n)
+
+            def do_POST(self):
+                try:
+                    if self.path == "/startup":
+                        req = json.loads(self._body())
+                        self._json(ps.startup(req["worker_id"]))
+                    elif self.path.startswith("/update"):
+                        q = _query(self.path)
+                        arrays = _loads_npz(self._body())
+                        self._json(ps.update(q["worker_id"], arrays["vec"]))
+                    elif self.path == "/progress":
+                        req = json.loads(self._body())
+                        with ps._lock:
+                            ps.progress[req["worker_id"]] = req
+                        self._json({"ok": True})
+                    elif self.path == "/complete":
+                        req = json.loads(self._body())
+                        self._json(ps.complete(req["worker_id"]))
+                    elif self.path == "/error":
+                        req = json.loads(self._body())
+                        ps.error(req["worker_id"], req.get("message", ""))
+                        self._json({"ok": True})
+                    elif self.path == "/metrics":
+                        ps.metrics_report(json.loads(self._body()))
+                        self._json({"ok": True})
+                    else:
+                        self._json({"error": "not found"}, 404)
+                except Exception as e:  # noqa: BLE001 — report to client
+                    self._json({"error": str(e)}, 500)
+
+            def do_GET(self):
+                if self.path == "/waiting":
+                    self._json(ps.waiting())
+                elif self.path.startswith("/fetch"):
+                    q = _query(self.path)
+                    vec = ps.fetch(int(q.get("update_id", "0")))
+                    if vec is None:
+                        self._json({"error": "round not published"}, 409)
+                    else:
+                        self._bytes(_dumps_npz({"vec": vec}))
+                elif self.path == "/metrics":
+                    with ps._lock:
+                        self._json(dict(ps.metrics))
+                else:
+                    self._json({"error": "not found"}, 404)
+
+        self._server = ThreadingHTTPServer(("127.0.0.1", port), Handler)
+        self._thread = threading.Thread(target=self._server.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+        return self._server.server_address[1]
+
+    def shutdown(self) -> None:
+        if self._server is not None:
+            self._server.shutdown()
+            self._server.server_close()
+
+
+def _query(path: str) -> Dict[str, str]:
+    if "?" not in path:
+        return {}
+    return dict(kv.split("=", 1) for kv in path.split("?", 1)[1].split("&"))
+
+
+class ParameterServerWorker:
+    """Worker-side client (`ApplicationWorker` analog)."""
+
+    def __init__(self, base_url: str, worker_id: str,
+                 poll_interval_s: float = 0.05, timeout_s: float = 30.0):
+        self.base = base_url.rstrip("/")
+        self.worker_id = worker_id
+        self.poll_interval_s = poll_interval_s
+        self.timeout_s = timeout_s
+
+    def _post_json(self, path: str, obj) -> dict:
+        req = urllib.request.Request(
+            self.base + path, data=json.dumps(obj).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=self.timeout_s) as r:
+            return json.loads(r.read())
+
+    def startup(self) -> dict:
+        return self._post_json("/startup", {"worker_id": self.worker_id})
+
+    def progress(self, **info) -> dict:
+        return self._post_json("/progress",
+                               {"worker_id": self.worker_id, **info})
+
+    def update(self, vec: np.ndarray) -> dict:
+        req = urllib.request.Request(
+            f"{self.base}/update?worker_id={self.worker_id}",
+            data=_dumps_npz({"vec": np.asarray(vec)}),
+            headers={"Content-Type": "application/octet-stream"})
+        with urllib.request.urlopen(req, timeout=self.timeout_s) as r:
+            return json.loads(r.read())
+
+    def waiting(self) -> dict:
+        with urllib.request.urlopen(self.base + "/waiting",
+                                    timeout=self.timeout_s) as r:
+            return json.loads(r.read())
+
+    def fetch(self, update_id: int) -> np.ndarray:
+        """Poll until round `update_id` is published, then return it."""
+        import time
+
+        deadline = time.monotonic() + self.timeout_s
+        while True:
+            try:
+                with urllib.request.urlopen(
+                        f"{self.base}/fetch?update_id={update_id}",
+                        timeout=self.timeout_s) as r:
+                    return _loads_npz(r.read())["vec"]
+            except urllib.error.HTTPError as e:
+                if e.code != 409 or time.monotonic() > deadline:
+                    raise
+                time.sleep(self.poll_interval_s)
+
+    def complete(self) -> dict:
+        return self._post_json("/complete", {"worker_id": self.worker_id})
+
+    def error(self, message: str) -> dict:
+        return self._post_json("/error", {"worker_id": self.worker_id,
+                                          "message": message})
+
+    def metrics_report(self, report: Dict[str, float]) -> dict:
+        return self._post_json("/metrics", report)

@@ -1,0 +1,97 @@
+"""HTTP iterative-reduce parameter server (#22 protocol parity)."""
+
+import threading
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.scaleout.param_server import (ParameterServer,
+                                                      ParameterServerWorker)
+
+
+@pytest.fixture
+def server():
+    ps = ParameterServer(np.zeros(4, np.float32), n_workers=2, iterations=3)
+    port = ps.serve(0)
+    yield ps, f"http://127.0.0.1:{port}"
+    ps.shutdown()
+
+
+def test_startup_assigns_splits(server):
+    ps, url = server
+    a = ParameterServerWorker(url, "wA").startup()
+    b = ParameterServerWorker(url, "wB").startup()
+    assert {a["split_index"], b["split_index"]} == {0, 1}
+    assert a["total_splits"] == 2 and a["iterations"] == 3
+
+
+def test_update_round_gates_until_all_workers(server):
+    ps, url = server
+    wa = ParameterServerWorker(url, "wA")
+    wb = ParameterServerWorker(url, "wB")
+    wa.startup(), wb.startup()
+    r = wa.update(np.full(4, 2.0, np.float32))
+    assert r["round"] == 0          # still waiting on wB
+    assert wa.waiting()["banked"] == 1
+    r = wb.update(np.full(4, 4.0, np.float32))
+    assert r["round"] == 1          # published: average of 2 and 4
+    got = wa.fetch(1)
+    np.testing.assert_allclose(got, np.full(4, 3.0))
+
+
+def test_fetch_polls_until_published(server):
+    ps, url = server
+    wa = ParameterServerWorker(url, "wA", poll_interval_s=0.01)
+    wb = ParameterServerWorker(url, "wB")
+    wa.startup(), wb.startup()
+
+    def late_update():
+        import time
+
+        time.sleep(0.1)
+        wa.update(np.ones(4, np.float32))
+        wb.update(np.ones(4, np.float32))
+
+    t = threading.Thread(target=late_update)
+    t.start()
+    got = wa.fetch(1)  # blocks (409-poll) until the round lands
+    t.join()
+    np.testing.assert_allclose(got, 1.0)
+
+
+def test_multi_round_bsp_training_loop(server):
+    """Two workers do 3 BSP rounds of 'local training' (+1 / +3)."""
+    ps, url = server
+
+    def work(name, delta, out):
+        w = ParameterServerWorker(url, name, poll_interval_s=0.01)
+        w.startup()
+        vec = np.zeros(4, np.float32)
+        for r in range(1, 4):
+            w.update(vec + delta)
+            vec = w.fetch(r)
+            w.progress(round=r)
+        w.metrics_report({"steps": 3})
+        w.complete()
+        out[name] = vec
+
+    out = {}
+    ts = [threading.Thread(target=work, args=(n, d, out))
+          for n, d in (("wA", 1.0), ("wB", 3.0))]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    # each round: average(vec+1, vec+3) = vec+2, three rounds -> 6
+    np.testing.assert_allclose(out["wA"], 6.0)
+    np.testing.assert_allclose(out["wB"], 6.0)
+    assert ps.metrics["steps"] == 6.0
+    assert ps.completed == {"wA", "wB"}
+
+
+def test_error_reporting(server):
+    ps, url = server
+    w = ParameterServerWorker(url, "wX")
+    w.startup()
+    w.error("container lost")
+    assert ps.errors["wX"] == "container lost"
