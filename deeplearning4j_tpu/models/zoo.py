@@ -119,3 +119,26 @@ def vgg_cifar10(lr: float = 0.05, iterations: int = 1,
         confs=confs, backprop=True,
         input_preprocessors=((0, "ff_to_conv:3:32:32"),
                              (9, "conv_to_ff")))
+
+
+def char_transformer(vocab: int, d_model: int = 128, n_blocks: int = 2,
+                     n_heads: int = 4, max_seq_len: int = 256,
+                     lr: float = 0.1, iterations: int = 1
+                     ) -> MultiLayerConfiguration:
+    """Decoder-only char transformer LM (new scope — the reference's only
+    sequence model is the scalar-loop LSTM).  Embedding (+ learned
+    positions) -> n_blocks x [causal MHA, FFN] -> per-token softmax."""
+    b = _base(lr=lr, iters=iterations)
+    confs = [b.replace(layer_type=LayerType.EMBEDDING, n_in=vocab,
+                       n_out=d_model, max_seq_len=max_seq_len)]
+    for _ in range(n_blocks):
+        confs.append(b.replace(layer_type=LayerType.ATTENTION, n_in=d_model,
+                               n_out=d_model, n_heads=n_heads, causal=True))
+        confs.append(b.replace(layer_type=LayerType.TRANSFORMER_FFN,
+                               n_in=d_model, n_out=d_model))
+    confs.append(b.replace(layer_type=LayerType.OUTPUT, n_in=d_model,
+                           n_out=vocab, activation=Activation.SOFTMAX,
+                           loss_function=LossFunction.MCXENT))
+    return MultiLayerConfiguration(
+        confs=tuple(confs), backprop=True,
+        input_preprocessors=((2 * n_blocks + 1, "rnn_to_ff"),))

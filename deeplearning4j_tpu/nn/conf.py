@@ -50,6 +50,7 @@ class LayerType(str, enum.Enum):
     BATCH_NORM = "batch_norm"
     EMBEDDING = "embedding"
     ATTENTION = "attention"
+    TRANSFORMER_FFN = "transformer_ffn"
 
     def __str__(self) -> str:
         return self.value
@@ -149,6 +150,8 @@ class NeuralNetConfiguration:
     causal: bool = False
     attention_block_size: int = 0  # 0 = full attention; >0 = blockwise/flash
     attention_impl: str = "auto"   # auto | full | blockwise | flash (pallas)
+    ffn_hidden: int = 0            # transformer FFN width (0 = 4*n_in)
+    max_seq_len: int = 0           # >0: learned positional embedding table
 
     # conv knobs (NCHW)
     kernel_size: Tuple[int, int] = (5, 5)

@@ -30,6 +30,7 @@ _REGISTRY = {
     LayerType.BATCH_NORM: base.BatchNormLayer,
     LayerType.EMBEDDING: base.EmbeddingLayer,
     LayerType.ATTENTION: attention.MultiHeadAttentionLayer,
+    LayerType.TRANSFORMER_FFN: attention.TransformerFFNLayer,
 }
 
 

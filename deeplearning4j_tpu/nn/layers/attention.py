@@ -84,3 +84,41 @@ def _layer_norm(x, g, b, eps: float = 1e-5):
     mu = jnp.mean(x, axis=-1, keepdims=True)
     var = jnp.var(x, axis=-1, keepdims=True)
     return (x - mu) * jax.lax.rsqrt(var + eps) * g + b
+
+
+class TransformerFFNLayer:
+    """Pre-LN residual MLP — the second half of a transformer block.
+
+    Hidden width = conf.ffn_hidden, defaulting to 4*n_in.  Pairs with
+    MultiHeadAttentionLayer to form [attention, ffn] blocks in a
+    MultiLayerConfiguration stack.
+    """
+
+    @staticmethod
+    def init(key, conf):
+        d = _dtype(conf)
+        n = conf.n_in
+        if conf.n_out not in (0, n):
+            raise ValueError(
+                f"ffn is residual: n_out must equal n_in={n} (or 0), "
+                f"got {conf.n_out}")
+        h = conf.ffn_hidden or 4 * n
+        k1, k2 = jax.random.split(key)
+        dist = conf.dist.sampler() if conf.dist is not None else None
+        return {
+            "W1": init_weights(k1, (n, h), conf.weight_init, dist, d),
+            "b1": jnp.zeros((h,), d),
+            "W2": init_weights(k2, (h, n), conf.weight_init, dist, d),
+            "b2": jnp.zeros((n,), d),
+            "ln_g": jnp.ones((n,), d),
+            "ln_b": jnp.zeros((n,), d),
+        }
+
+    @staticmethod
+    def forward(params, conf, x, key=None, training=False):
+        xn = _layer_norm(x, params["ln_g"], params["ln_b"])
+        h = jax.nn.gelu(xn @ params["W1"] + params["b1"])
+        o = h @ params["W2"] + params["b2"]
+        if training and conf.dropout > 0.0 and key is not None:
+            o = o * ndr.dropout_mask(key, 1.0 - conf.dropout, o.shape, o.dtype)
+        return x + o

@@ -100,16 +100,32 @@ class BatchNormLayer:
 
 class EmbeddingLayer:
     """Integer ids -> embedding rows (gather; MXU-friendly one-hot matmul for
-    tiny vocabularies is not worth it — XLA lowers gather well on TPU)."""
+    tiny vocabularies is not worth it — XLA lowers gather well on TPU).
+
+    With conf.max_seq_len > 0 a learned positional table is added over the
+    sequence axis (transformer-LM input embedding)."""
 
     @staticmethod
     def init(key, conf):
         dist = conf.dist.sampler() if conf.dist is not None else None
-        return {
-            "W": init_weights(key, (conf.n_in, conf.n_out), conf.weight_init,
+        kw, kp = jax.random.split(key)
+        params = {
+            "W": init_weights(kw, (conf.n_in, conf.n_out), conf.weight_init,
                               dist, _dtype(conf)),
         }
+        if conf.max_seq_len > 0:
+            params["P"] = 0.02 * jax.random.normal(
+                kp, (conf.max_seq_len, conf.n_out), _dtype(conf))
+        return params
 
     @staticmethod
     def forward(params, conf, x, key=None, training=False):
-        return params["W"][x.astype(jnp.int32)]
+        e = params["W"][x.astype(jnp.int32)]
+        if "P" in params and e.ndim >= 2:
+            s = e.shape[-2]
+            if s > params["P"].shape[0]:
+                raise ValueError(
+                    f"sequence length {s} exceeds max_seq_len "
+                    f"{params['P'].shape[0]}")
+            e = e + params["P"][:s]
+        return e
