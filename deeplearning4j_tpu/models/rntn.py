@@ -238,6 +238,17 @@ class RNTN:
                 self.n_classes)
             self._hist = jax.tree_util.tree_map(
                 lambda p: jnp.full_like(p, 1e-8), self.params)
+        elif len(self.vocab) > self.params["E"].shape[0]:
+            # later fit() calls may grow the vocab: extend the embedding
+            # table (and its AdaGrad history) for the new words
+            n_new = len(self.vocab) - self.params["E"].shape[0]
+            r = 1.0 / np.sqrt(self.dim)
+            rows = jax.random.uniform(
+                jax.random.PRNGKey(self.seed + len(self.vocab)),
+                (n_new, self.dim), self.params["E"].dtype, -r, r)
+            self.params["E"] = jnp.concatenate([self.params["E"], rows])
+            self._hist["E"] = jnp.concatenate(
+                [self._hist["E"], jnp.full_like(rows, 1e-8)])
         plans = self._plans(trees)
 
         @jax.jit

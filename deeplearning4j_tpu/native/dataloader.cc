@@ -10,6 +10,7 @@
 //
 // C ABI only — consumed from Python via ctypes (no pybind11 in this image).
 
+#include <atomic>
 #include <cerrno>
 #include <cstdint>
 #include <cstdio>
@@ -124,15 +125,17 @@ namespace {
 // non-numeric field (caller falls back to Python).
 bool parse_rows(const char* base, const std::vector<const char*>& starts,
                 const std::vector<const char*>& ends, int64_t r0, int64_t r1,
-                int64_t cols, float* out, bool* bad) {
+                int64_t cols, float* out, std::atomic<bool>* bad) {
   for (int64_t r = r0; r < r1; ++r) {
     const char* s = starts[size_t(r)];
     const char* line_end = ends[size_t(r)];
     for (int64_t c = 0; c < cols; ++c) {
       char* next = nullptr;
-      double v = std::strtod(s, &next);
-      if (next == s) {
-        *bad = true;
+      // empty field (s at the separator/newline) or strtod running past
+      // the line (it skips '\n' as whitespace) must reject, not fabricate
+      double v = (s < line_end) ? std::strtod(s, &next) : 0.0;
+      if (next == s || next == nullptr || next > line_end) {
+        bad->store(true, std::memory_order_relaxed);
         return false;
       }
       out[r * cols + c] = static_cast<float>(v);
@@ -180,7 +183,7 @@ int dl4j_csv_read(const char* path, int skip_header, float* out, int64_t rows,
                         : int(std::thread::hardware_concurrency());
   if (nt < 1) nt = 1;
   if (int64_t(nt) > rows) nt = int(rows ? rows : 1);
-  bool bad = false;
+  std::atomic<bool> bad{false};
   if (nt == 1) {
     parse_rows(fb.data.data(), starts, ends, 0, rows, cols, out, &bad);
   } else {
@@ -195,7 +198,7 @@ int dl4j_csv_read(const char* path, int skip_header, float* out, int64_t rows,
     }
     for (auto& th : ts) th.join();
   }
-  return bad ? -2 : 0;
+  return bad.load() ? -2 : 0;
 }
 
 }  // extern "C"

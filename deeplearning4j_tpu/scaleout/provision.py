@@ -182,8 +182,9 @@ class LocalBlobStore(BlobStore):
         os.makedirs(root, exist_ok=True)
 
     def _path(self, key: str) -> str:
-        p = os.path.normpath(os.path.join(self.root, key))
-        if not p.startswith(os.path.normpath(self.root)):
+        root = os.path.normpath(self.root)
+        p = os.path.normpath(os.path.join(root, key))
+        if p != root and not p.startswith(root + os.sep):
             raise ValueError(f"key escapes store root: {key}")
         return p
 

@@ -61,20 +61,38 @@ class StepTimer:
 
 
 class ThroughputMeter:
-    """samples/sec over device-blocking steps (timings measure compute)."""
+    """samples/sec over device-blocking steps (timings measure compute).
+
+    The with-body registers its device result via `block(...)` so the
+    timer can synchronize on work created *inside* the block (JAX dispatch
+    is async; without the sync only dispatch latency would be measured):
+
+        with meter.measure(batch) as m:
+            m.block(step(params, x))
+    """
+
+    class _Measurement:
+        def __init__(self):
+            self._results = []
+
+        def block(self, result):
+            """Register a device value to synchronize on; returns it."""
+            self._results.append(result)
+            return result
 
     def __init__(self):
         self.samples = 0
         self.seconds = 0.0
 
     @contextlib.contextmanager
-    def measure(self, batch_size: int, result_to_block_on=None):
+    def measure(self, batch_size: int):
+        m = self._Measurement()
         t0 = time.perf_counter()
-        yield
-        if result_to_block_on is not None:
+        yield m
+        if m._results:
             import jax
 
-            jax.block_until_ready(result_to_block_on)
+            jax.block_until_ready(m._results)
         self.seconds += time.perf_counter() - t0
         self.samples += batch_size
 

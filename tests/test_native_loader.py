@@ -102,3 +102,12 @@ def test_python_fallback_when_native_disabled(tmp_path, monkeypatch):
     ds = CSVDataFetcher(p, label_column=-1).fetch()
     assert ds.features.shape == (2, 2)
     monkeypatch.setattr(nat, "_load_failed", False)  # restore probe state
+
+
+@needs_native
+def test_native_csv_rejects_empty_trailing_field(tmp_path):
+    # strtod must not cross the newline and parse the next row's value
+    p = str(tmp_path / "ragged.csv")
+    with open(p, "w") as f:
+        f.write("1.0,\n2.0,3.0\n")
+    assert native_read_csv(p) is None

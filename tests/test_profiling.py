@@ -24,10 +24,11 @@ def test_step_timer_summary():
 def test_throughput_meter_blocks_on_device():
     m = ThroughputMeter()
     x = jnp.ones((64, 64))
-    with m.measure(128, result_to_block_on=x @ x):
-        y = x @ x
+    with m.measure(128) as meas:
+        y = meas.block(x @ x)  # created inside the block, synced before stop
     assert m.samples == 128
     assert m.samples_per_sec > 0
+    assert y.shape == (64, 64)
 
 
 def test_metrics_registry_report():

@@ -86,3 +86,10 @@ def test_blob_dataset_iterator(tmp_path):
     assert len(parts) == 3
     assert parts[1].features.shape == (4, 2)
     np.testing.assert_allclose(parts[2].features, 2.0)
+
+
+def test_blob_store_rejects_sibling_prefix_escape(tmp_path):
+    import pytest
+    store = LocalBlobStore(str(tmp_path / "store"))
+    with pytest.raises(ValueError, match="escapes"):
+        store.upload("../store-evil/f", __file__)

@@ -63,3 +63,12 @@ def test_rntn_predict_unseen_composition():
     root_pred, node_preds = model.predict("(1 (1 great) (1 happy))")
     assert root_pred == 1
     assert len(node_preds) == 3
+
+
+def test_rntn_refit_grows_vocab():
+    model = RNTN(dim=8, n_classes=2, max_nodes=16, lr=0.1, seed=0)
+    model.fit(POS, epochs=20)
+    n0 = model.params["E"].shape[0]
+    model.fit(NEG, epochs=20)  # new words must extend the embedding table
+    assert model.params["E"].shape[0] == len(model.vocab) > n0
+    assert model._hist["E"].shape == model.params["E"].shape
