@@ -1,0 +1,68 @@
+"""Sentiment lexicon — SentiWordNet-reader parity.
+
+The reference bundles a SentiWordNet corpus reader (SURVEY.md §1 L6:
+"SentiWordNet corpus reader" under text/corpora) whose scores label tree
+nodes for RNTN sentiment training.  Same contract here: parse the standard
+SentiWordNet 3.x TSV format (`POS<TAB>ID<TAB>PosScore<TAB>NegScore<TAB>
+SynsetTerms...`), expose per-word polarity, and act as a `label_fn` for
+`text/tree_parser.TreeParser`.  A small built-in lexicon keeps everything
+hermetic when no corpus file is available.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+_BUILTIN = {
+    "good": 0.75, "great": 0.88, "excellent": 1.0, "nice": 0.6,
+    "happy": 0.8, "love": 0.9, "wonderful": 0.9, "best": 0.9,
+    "fine": 0.4, "amazing": 0.9, "fantastic": 0.9, "positive": 0.6,
+    "bad": -0.65, "awful": -0.9, "terrible": -0.9, "poor": -0.6,
+    "sad": -0.7, "hate": -0.9, "worst": -1.0, "horrible": -0.9,
+    "negative": -0.6, "wrong": -0.5, "ugly": -0.7, "boring": -0.6,
+}
+
+
+class SentimentLexicon:
+    def __init__(self, scores: Optional[Dict[str, float]] = None):
+        self.scores = dict(_BUILTIN if scores is None else scores)
+
+    @classmethod
+    def from_sentiwordnet(cls, path: str) -> "SentimentLexicon":
+        """Parse SentiWordNet 3.x TSV (comment lines start with '#')."""
+        acc: Dict[str, list] = {}
+        with open(path) as f:
+            for line in f:
+                if not line.strip() or line.startswith("#"):
+                    continue
+                parts = line.rstrip("\n").split("\t")
+                if len(parts) < 5:
+                    continue
+                try:
+                    pos_s, neg_s = float(parts[2]), float(parts[3])
+                except ValueError:
+                    continue
+                for term in parts[4].split():
+                    word = term.rsplit("#", 1)[0].lower()
+                    acc.setdefault(word, []).append(pos_s - neg_s)
+        return cls({w: sum(v) / len(v) for w, v in acc.items()})
+
+    def score(self, word: str) -> float:
+        """Polarity in [-1, 1]; 0 for unknown words."""
+        return self.scores.get(word.lower(), 0.0)
+
+    def label(self, word: str, n_classes: int = 2) -> int:
+        """Class label for tree nodes: binary {neg=0, pos=1} or
+        {neg=0, neutral=1, pos=2} for n_classes=3."""
+        s = self.score(word)
+        if n_classes == 2:
+            return 1 if s > 0 else 0
+        if s > 0.1:
+            return 2
+        if s < -0.1:
+            return 0
+        return 1
+
+    def label_fn(self, n_classes: int = 2):
+        """`label_fn` for TreeParser."""
+        return lambda tok: self.label(tok, n_classes)

@@ -1,0 +1,59 @@
+"""PoS tagging/filtering + sentiment lexicon (row-24 text infra)."""
+
+from deeplearning4j_tpu.text.pos import PosFilterTokenizerFactory, PosTagger
+from deeplearning4j_tpu.text.sentiment_lexicon import SentimentLexicon
+from deeplearning4j_tpu.text.tokenization import DefaultTokenizerFactory
+
+
+def test_pos_tagger_basic_tags():
+    tags = PosTagger().tag("the quick dogs quickly running jumped over 42"
+                           .split())
+    assert tags[0] == "DT"
+    assert tags[2] == "NNS"      # dogs
+    assert tags[3] == "RB"       # quickly
+    assert tags[4] == "VBG"      # running
+    assert tags[5] == "VBD"      # jumped
+    assert tags[6] == "IN"       # over
+    assert tags[7] == "CD"       # 42
+
+
+def test_pos_filter_tokenizer_keeps_allowed():
+    f = PosFilterTokenizerFactory(DefaultTokenizerFactory(),
+                                  allowed_tags={"NN", "NNS"})
+    toks = f.tokenize("the creation of several dogs quickly")
+    assert "creation" in toks and "dogs" in toks
+    assert "the" not in toks and "quickly" not in toks
+    # create() returns a Tokenizer over the filtered stream
+    assert f.create("the dogs").get_tokens() == ["dogs"]
+
+
+def test_sentiment_lexicon_builtin():
+    lex = SentimentLexicon()
+    assert lex.score("great") > 0 > lex.score("awful")
+    assert lex.score("zyzzyva") == 0.0
+    assert lex.label("great") == 1 and lex.label("awful") == 0
+    assert lex.label("table", n_classes=3) == 1  # neutral
+
+
+def test_sentiwordnet_file_parsing(tmp_path):
+    p = tmp_path / "swn.txt"
+    p.write_text(
+        "# SentiWordNet comment\n"
+        "a\t00001740\t0.75\t0\tgood#1 great#2\n"
+        "a\t00002098\t0\t0.875\tbad#1\n"
+        "a\t00002312\t0.25\t0.125\tgood#3\n")
+    lex = SentimentLexicon.from_sentiwordnet(str(p))
+    assert abs(lex.score("good") - (0.75 + 0.125) / 2) < 1e-9
+    assert lex.score("bad") == -0.875
+    assert lex.score("great") == 0.75
+
+
+def test_lexicon_labels_trees_for_rntn():
+    from deeplearning4j_tpu.text.tree_parser import TreeParser
+
+    lex = SentimentLexicon()
+    parser = TreeParser(strategy="balanced", label_fn=lex.label_fn(2))
+    t = parser.parse("great wonderful day")
+    from deeplearning4j_tpu.models.rntn import tree_tokens
+    assert tree_tokens(t) == ["great", "wonderful", "day"]
+    assert t.left.label == 1  # "great" positive
