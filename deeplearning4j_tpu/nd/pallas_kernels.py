@@ -155,11 +155,11 @@ flash_attention.defvjp(_flash_fwd, _flash_bwd)
 
 def _lstm_cell_kernel(x_ref, h_ref, c_ref, wx_ref, wh_ref, b_ref,
                       h_out_ref, c_out_ref):
-    """Fused LSTM cell: gates = x@Wx + h@Wh + b; standard ifgo update.
+    """Fused LSTM cell: gates = x@Wx + h@Wh + b.
 
-    Gate layout along the 4H axis: [i | f | g | o] (fused single matmul —
-    the TPU analog of the reference's concatenated iFog weight matrix,
-    `LSTM.java:161-228`).
+    Gate layout along the 4H axis: [i | f | o | g] — the same order as
+    `nn/layers/lstm.LSTMLayer` (the TPU analog of the reference's
+    concatenated iFog weight matrix, `LSTM.java:161-228`).
     """
     hdim = h_ref.shape[1]
     z = (jnp.dot(x_ref[:], wx_ref[:], preferred_element_type=jnp.float32)
@@ -167,16 +167,26 @@ def _lstm_cell_kernel(x_ref, h_ref, c_ref, wx_ref, wh_ref, b_ref,
          + b_ref[:])
     i = jax.nn.sigmoid(z[:, 0 * hdim:1 * hdim])
     f = jax.nn.sigmoid(z[:, 1 * hdim:2 * hdim])
-    g = jnp.tanh(z[:, 2 * hdim:3 * hdim])
-    o = jax.nn.sigmoid(z[:, 3 * hdim:4 * hdim])
+    o = jax.nn.sigmoid(z[:, 2 * hdim:3 * hdim])
+    g = jnp.tanh(z[:, 3 * hdim:4 * hdim])
     c_new = f * c_ref[:] + i * g
     h_out_ref[:] = (o * jnp.tanh(c_new)).astype(h_out_ref.dtype)
     c_out_ref[:] = c_new.astype(c_out_ref.dtype)
 
 
-def fused_lstm_step(x, h, c, wx, wh, b, interpret: Optional[bool] = None):
-    """One fused LSTM cell update.  x:[B,I] h,c:[B,H] wx:[I,4H] wh:[H,4H]
-    b:[4H] -> (h_new, c_new)."""
+def _lstm_reference(x, h, c, wx, wh, b):
+    """jax-level twin of the kernel (same [i f o g] order) for the VJP."""
+    hdim = h.shape[1]
+    z = x @ wx + h @ wh + b
+    i = jax.nn.sigmoid(z[:, :hdim])
+    f = jax.nn.sigmoid(z[:, hdim:2 * hdim])
+    o = jax.nn.sigmoid(z[:, 2 * hdim:3 * hdim])
+    g = jnp.tanh(z[:, 3 * hdim:])
+    c_new = f * c + i * g
+    return o * jnp.tanh(c_new), c_new
+
+
+def _fused_lstm_impl(x, h, c, wx, wh, b, interpret):
     bsz, hdim = h.shape
     out_shape = (jax.ShapeDtypeStruct((bsz, hdim), h.dtype),
                  jax.ShapeDtypeStruct((bsz, hdim), c.dtype))
@@ -185,6 +195,28 @@ def fused_lstm_step(x, h, c, wx, wh, b, interpret: Optional[bool] = None):
         out_shape=out_shape,
         interpret=_interpret(interpret),
     )(x, h, c, wx, wh, b[None, :])
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(6,))
+def fused_lstm_step(x, h, c, wx, wh, b, interpret: Optional[bool] = None):
+    """One fused LSTM cell update.  x:[B,I] h,c:[B,H] wx:[I,4H] wh:[H,4H]
+    b:[4H] -> (h_new, c_new).  Differentiable: backward recomputes the
+    cell at jax level (cheap — one cell) and uses its VJP, so the layer
+    can train through the Pallas forward."""
+    return _fused_lstm_impl(x, h, c, wx, wh, b, interpret)
+
+
+def _lstm_fwd(x, h, c, wx, wh, b, interpret):
+    out = _fused_lstm_impl(x, h, c, wx, wh, b, interpret)
+    return out, (x, h, c, wx, wh, b)
+
+
+def _lstm_bwd(interpret, res, g):
+    _, vjp = jax.vjp(_lstm_reference, *res)
+    return vjp(g)
+
+
+fused_lstm_step.defvjp(_lstm_fwd, _lstm_bwd)
 
 
 # ------------------------------------------------------------- scatter-add

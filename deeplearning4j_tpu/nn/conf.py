@@ -152,6 +152,7 @@ class NeuralNetConfiguration:
     attention_impl: str = "auto"   # auto | full | blockwise | flash (pallas)
     ffn_hidden: int = 0            # transformer FFN width (0 = 4*n_in)
     max_seq_len: int = 0           # >0: learned positional embedding table
+    lstm_impl: str = "auto"        # auto | scan | fused (pallas cell)
 
     # conv knobs (NCHW)
     kernel_size: Tuple[int, int] = (5, 5)

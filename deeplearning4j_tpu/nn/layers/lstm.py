@@ -47,18 +47,40 @@ class LSTMLayer:
         return (h, c), h
 
     @staticmethod
+    def _use_fused(conf) -> bool:
+        impl = getattr(conf, "lstm_impl", "auto")
+        if impl == "auto":
+            return jax.devices()[0].platform == "tpu"
+        return impl == "fused"
+
+    @staticmethod
     def forward(params, conf, x, key=None, training=False):
         """x: [batch, time, n_in] -> hidden states [batch, time, n_out]."""
         if x.ndim == 2:  # single sequence [time, n_in] (reference shape)
             return LSTMLayer.forward(params, conf, x[None], key, training)[0]
         B, T, _ = x.shape
         n_h = conf.n_out
+        n_in = conf.n_in
         h0 = jnp.zeros((B, n_h), x.dtype)
         c0 = jnp.zeros((B, n_h), x.dtype)
         xs = jnp.swapaxes(x, 0, 1)  # [time, batch, n_in] for scan
-        (_, _), hs = jax.lax.scan(
-            lambda carry, x_t: LSTMLayer._step(params, n_h, carry, x_t),
-            (h0, c0), xs)
+
+        if LSTMLayer._use_fused(conf):
+            # Pallas cell: one kernel per step (both matmuls + gates +
+            # state update fused); W splits into input/recurrent halves
+            from deeplearning4j_tpu.nd.pallas_kernels import fused_lstm_step
+
+            wx, wh = params["W"][:n_in], params["W"][n_in:]
+
+            def step(carry, x_t):
+                h, c = carry
+                h, c = fused_lstm_step(x_t, h, c, wx, wh, params["b"])
+                return (h, c), h
+        else:
+            def step(carry, x_t):
+                return LSTMLayer._step(params, n_h, carry, x_t)
+
+        (_, _), hs = jax.lax.scan(step, (h0, c0), xs)
         return jnp.swapaxes(hs, 0, 1)
 
     @staticmethod

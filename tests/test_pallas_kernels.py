@@ -58,8 +58,8 @@ def test_fused_lstm_step_matches_reference():
     h_new, c_new = fused_lstm_step(x, h, c, wx, wh, b)
 
     z = x @ wx + h @ wh + b
-    i, f, g, o = (jax.nn.sigmoid(z[:, :H]), jax.nn.sigmoid(z[:, H:2 * H]),
-                  jnp.tanh(z[:, 2 * H:3 * H]), jax.nn.sigmoid(z[:, 3 * H:]))
+    i, f, o, g = (jax.nn.sigmoid(z[:, :H]), jax.nn.sigmoid(z[:, H:2 * H]),
+                  jax.nn.sigmoid(z[:, 2 * H:3 * H]), jnp.tanh(z[:, 3 * H:]))
     c_ref = f * c + i * g
     h_ref = o * jnp.tanh(c_ref)
     np.testing.assert_allclose(np.asarray(h_new), np.asarray(h_ref),
@@ -106,3 +106,39 @@ def test_attention_layer_flash_impl():
     y_ref = layer.forward(params, conf_full, x)
     np.testing.assert_allclose(np.asarray(y), np.asarray(y_ref),
                                rtol=2e-5, atol=2e-5)
+
+
+def test_lstm_layer_fused_matches_scan():
+    from deeplearning4j_tpu.nn.conf import LayerType, NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.layers import get_layer
+
+    conf = NeuralNetConfiguration(layer_type=LayerType.LSTM, n_in=8,
+                                  n_out=16, lstm_impl="scan")
+    layer = get_layer(conf.layer_type)
+    params = layer.init(jax.random.PRNGKey(0), conf)
+    x = jax.random.normal(jax.random.PRNGKey(1), (3, 7, 8))
+    y_scan = layer.forward(params, conf, x)
+    y_fused = layer.forward(params, conf.replace(lstm_impl="fused"), x)
+    np.testing.assert_allclose(np.asarray(y_fused), np.asarray(y_scan),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_lstm_layer_fused_grads_match_scan():
+    from deeplearning4j_tpu.nn.conf import LayerType, NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.layers import get_layer
+
+    conf = NeuralNetConfiguration(layer_type=LayerType.LSTM, n_in=4,
+                                  n_out=8, lstm_impl="scan")
+    layer = get_layer(conf.layer_type)
+    params = layer.init(jax.random.PRNGKey(2), conf)
+    x = jax.random.normal(jax.random.PRNGKey(3), (2, 5, 4))
+
+    def loss(p, c):
+        return jnp.sum(layer.forward(p, c, x) ** 2)
+
+    g_scan = jax.grad(loss)(params, conf)
+    g_fused = jax.grad(loss)(params, conf.replace(lstm_impl="fused"))
+    for k in g_scan:
+        np.testing.assert_allclose(np.asarray(g_fused[k]),
+                                   np.asarray(g_scan[k]),
+                                   rtol=1e-4, atol=1e-5)
