@@ -164,7 +164,10 @@ class NeuralNetConfiguration:
     # misc
     batch_size: int = 0             # 0 = whatever the iterator yields
     seed: int = 123
-    dtype: str = "float32"          # params dtype; compute may use bfloat16
+    dtype: str = "float32"          # params (master-weight) dtype
+    compute_dtype: str = ""         # matmul/conv operand dtype ("" = dtype);
+                                    # "bfloat16" = mixed precision: bf16 MXU
+                                    # inputs, f32 accumulation, f32 params
 
     def replace(self, **kwargs) -> "NeuralNetConfiguration":
         return dataclasses.replace(self, **kwargs)

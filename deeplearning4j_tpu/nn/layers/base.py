@@ -22,6 +22,19 @@ def _dtype(conf):
     return jnp.dtype(conf.dtype)
 
 
+def compute_dtype(conf):
+    cd = getattr(conf, "compute_dtype", "")
+    return jnp.dtype(cd) if cd else jnp.dtype(conf.dtype)
+
+
+def mixed_matmul(x, W, conf):
+    """x @ W with operands in conf.compute_dtype — bf16 feeds the MXU at
+    full rate while params stay f32 (output cast back to the param dtype;
+    TPU bf16 matmuls accumulate in f32 on the MXU)."""
+    cd = compute_dtype(conf)
+    return (x.astype(cd) @ W.astype(cd)).astype(W.dtype)
+
+
 class DenseLayer:
     """f(x.W + b) with optional dropout/dropconnect."""
 
@@ -40,7 +53,7 @@ class DenseLayer:
         W = params["W"]
         if training and conf.drop_connect and key is not None:
             W = W * ndr.dropout_mask(key, 0.5, W.shape, W.dtype)
-        return x @ W + params["b"]
+        return mixed_matmul(x, W, conf) + params["b"]
 
     @staticmethod
     def forward(params, conf, x, key=None, training=False):

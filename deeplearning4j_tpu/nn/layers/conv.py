@@ -25,12 +25,19 @@ from deeplearning4j_tpu.nn.layers.base import _dtype
 from deeplearning4j_tpu.nn.weights import init_weights
 
 
-def conv2d(x, w, stride=(1, 1), padding=(0, 0)):
-    """NCHW conv: x [B,C,H,W], w [O,C,kh,kw]."""
+def conv2d(x, w, stride=(1, 1), padding=(0, 0), operand_dtype=None):
+    """NCHW conv: x [B,C,H,W], w [O,C,kh,kw].
+
+    `operand_dtype` (mixed precision): cast both operands (bf16 feeds the
+    MXU at full rate) while accumulating in f32."""
     pad = [(padding[0], padding[0]), (padding[1], padding[1])]
-    return lax.conv_general_dilated(
-        x, w, window_strides=tuple(stride), padding=pad,
-        dimension_numbers=("NCHW", "OIHW", "NCHW"))
+    od = operand_dtype or w.dtype
+    # both operands in od, output cast back: keeps the transpose (backward)
+    # convs dtype-consistent; TPU bf16 convs accumulate in f32 on the MXU
+    out = lax.conv_general_dilated(
+        x.astype(od), w.astype(od), window_strides=tuple(stride),
+        padding=pad, dimension_numbers=("NCHW", "OIHW", "NCHW"))
+    return out.astype(w.dtype)
 
 
 def pool2d(x, kind: PoolingType, window=(2, 2), stride=None):
@@ -70,7 +77,9 @@ class ConvolutionLayer:
 
     @staticmethod
     def forward(params, conf, x, key=None, training=False):
-        z = conv2d(x, params["W"], conf.stride, conf.padding)
+        from deeplearning4j_tpu.nn.layers.base import compute_dtype
+        z = conv2d(x, params["W"], conf.stride, conf.padding,
+                   operand_dtype=compute_dtype(conf))
         z = z + params["b"][None, :, None, None]
         return activate(conf.activation, z)
 

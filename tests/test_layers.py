@@ -151,3 +151,25 @@ def test_vgg_cifar_forward_shape():
     x = jnp.zeros((2, 3 * 32 * 32), jnp.float32)
     out = network_output(conf, params, x)
     assert out.shape == (2, 10)
+
+
+def test_mixed_precision_compute_dtype():
+    """bf16 compute with f32 params: outputs close to full f32, params f32."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from deeplearning4j_tpu.nn.conf import LayerType, NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.layers import get_layer
+
+    conf = NeuralNetConfiguration(layer_type=LayerType.DENSE, n_in=32,
+                                  n_out=16, activation="tanh")
+    layer = get_layer(conf.layer_type)
+    params = layer.init(jax.random.PRNGKey(0), conf)
+    assert params["W"].dtype == jnp.float32
+    x = jax.random.normal(jax.random.PRNGKey(1), (4, 32))
+    y32 = layer.forward(params, conf, x)
+    y16 = layer.forward(params, conf.replace(compute_dtype="bfloat16"), x)
+    assert y16.dtype == jnp.float32  # cast back to the param dtype
+    np.testing.assert_allclose(np.asarray(y16), np.asarray(y32),
+                               rtol=2e-2, atol=2e-2)
