@@ -31,8 +31,10 @@ def _parse_properties(props: Optional[str]) -> dict:
     return out
 
 
-def _load_model(model_dir: str):
+def _load_model(model_dir):
     """Checkpoint dir -> initialized MultiLayerNetwork with restored params."""
+    if not model_dir:
+        raise SystemExit("this command requires --model <checkpoint dir>")
     from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
     from deeplearning4j_tpu.parallel import checkpoint
 
@@ -43,16 +45,45 @@ def _load_model(model_dir: str):
     return net
 
 
+def _zoo_conf(spec: str, data):
+    """--zoo 'name[:k=v,...]' -> MultiLayerConfiguration, sized from the
+    loaded dataset where needed (vocab for char models, dims for mlp)."""
+    from deeplearning4j_tpu.models import zoo
+
+    name, _, props = spec.partition(":")
+    kw = dict(kv.split("=", 1) for kv in props.split(",") if kv)
+    lr = float(kw.get("lr", 0.05))
+    iters = int(kw.get("iterations", kw.get("iters", 1)))
+    if name == "lenet5":
+        return zoo.lenet5(lr=lr, iterations=iters)
+    if name == "mlp":
+        hidden = [int(h) for h in kw.get("hidden", "64").split("x")]
+        return zoo.mlp(n_in=data.features.shape[-1], hidden=hidden,
+                       n_out=data.labels.shape[-1], lr=lr)
+    if name == "char_lstm":
+        vocab = getattr(data, "vocab_size", data.features.shape[-1])
+        return zoo.char_lstm(vocab, hidden=int(kw.get("hidden", 128)),
+                             n_layers=int(kw.get("layers", 1)), lr=lr,
+                             iterations=iters)
+    raise SystemExit(f"unknown --zoo model '{name}' "
+                     "(choose lenet5, mlp, char_lstm)")
+
+
 def cmd_train(args) -> int:
     from deeplearning4j_tpu.cli.schemes import load_input
     from deeplearning4j_tpu.nn.conf import MultiLayerConfiguration
     from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
     from deeplearning4j_tpu.parallel import checkpoint
 
-    with open(args.model) as f:
-        conf = MultiLayerConfiguration.from_json(f.read())
     data = load_input(args.input, label_column=args.label_column,
                       num_examples=args.num_examples)
+    if getattr(args, "zoo", None):
+        conf = _zoo_conf(args.zoo, data)
+    elif args.model:
+        with open(args.model) as f:
+            conf = MultiLayerConfiguration.from_json(f.read())
+    else:
+        raise SystemExit("train needs --model <conf.json> or --zoo <name>")
     if args.normalize:
         data = data.normalize_zero_mean_unit_variance()
 
@@ -137,8 +168,9 @@ def cmd_predict(args) -> int:
 
 def _add_common(p: argparse.ArgumentParser) -> None:
     p.add_argument("--input", required=True,
-                   help="mnist|iris|lfw|curves|csv:<path>[:label_col]|*.csv")
-    p.add_argument("--model", required=True,
+                   help="mnist|iris|lfw|curves|csv:<path>[:label_col]|"
+                        "text:<path>[:seq_len]|*.csv")
+    p.add_argument("--model", default=None,
                    help="conf JSON (train) or checkpoint dir (test/predict)")
     p.add_argument("--label-column", type=int, default=-1)
     p.add_argument("--num-examples", type=int, default=None)
@@ -154,6 +186,10 @@ def build_parser() -> argparse.ArgumentParser:
     t = sub.add_parser("train", help="train a model from a conf JSON")
     _add_common(t)
     t.add_argument("--output", required=True, help="checkpoint output dir")
+    t.add_argument("--zoo", default=None,
+                   help="train a zoo model instead of a conf JSON: "
+                        "lenet5|mlp|char_lstm[:k=v,...] (e.g. "
+                        "char_lstm:layers=4,hidden=128)")
     t.add_argument("--runtime", choices=["local", "mesh"], default="local")
     t.add_argument("--properties", default=None,
                    help="k=v[,k=v...] train properties: epochs, batch, mode")

@@ -112,3 +112,34 @@ class TestEndToEnd:
         assert rc == 0
         stats = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
         assert stats["accuracy"] > 0.7
+
+
+def test_cli_north_star_lenet_and_lstm_from_cli(tmp_path):
+    """BASELINE north star: LeNet-MNIST and a 4-layer LSTM end-to-end
+    from the CLI (zoo configs, no hand-written JSON)."""
+    from deeplearning4j_tpu.cli.driver import main
+
+    out1 = str(tmp_path / "lenet_ckpt")
+    rc = main(["train", "--zoo", "lenet5:lr=0.05", "--input", "mnist:64",
+               "--output", out1, "--properties", "epochs=1"])
+    assert rc == 0
+
+    corpus = tmp_path / "corpus.txt"
+    corpus.write_text("hello world " * 200)
+    out2 = str(tmp_path / "lstm_ckpt")
+    rc = main(["train", "--zoo", "char_lstm:layers=4,hidden=32,lr=0.1",
+               "--input", f"text:{corpus}:16", "--num-examples", "32",
+               "--output", out2])
+    assert rc == 0
+    import os
+    assert os.path.isdir(out1) and os.path.isdir(out2)
+
+
+def test_cli_train_requires_model_or_zoo(tmp_path):
+    import pytest
+
+    from deeplearning4j_tpu.cli.driver import main
+
+    with pytest.raises(SystemExit, match="--model|--zoo"):
+        main(["train", "--input", "iris:30",
+              "--output", str(tmp_path / "x")])
