@@ -1,0 +1,156 @@
+"""Recursive autoencoder over binary trees (Socher RAE).
+
+Parity: reference `nn/layers/feedforward/autoencoder/recursive/
+RecursiveAutoEncoder.java` (greedy tree RAE: encode child pairs bottom-up,
+reconstruct them, minimize reconstruction error).  TPU-native design reuses
+the RNTN tree-plan machinery (`models/rntn.plan_tree`): each tree becomes a
+static post-order plan evaluated by one `lax.scan`, internal nodes encode
+[left; right] -> d and the loss sums per-node reconstruction errors, so a
+batch of trees trains as a single jitted vmap'd program with `jax.grad`
+(no hand-written tree backprop).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from deeplearning4j_tpu.models.rntn import (TreeNode, parse_tree, plan_tree,
+                                            stack_plans, tree_tokens)
+
+
+def init_rae_params(key, vocab_size: int, dim: int, dtype=jnp.float32):
+    ke, kw, kd = jax.random.split(key, 3)
+    r = 1.0 / np.sqrt(dim)
+    return {
+        "E": jax.random.uniform(ke, (vocab_size, dim), dtype, -r, r),
+        "We": jax.random.uniform(kw, (2 * dim, dim), dtype, -r, r),
+        "be": jnp.zeros((dim,), dtype),
+        "Wd": jax.random.uniform(kd, (dim, 2 * dim), dtype, -r, r),
+        "bd": jnp.zeros((2 * dim,), dtype),
+    }
+
+
+def _encode(params, a, b):
+    return jnp.tanh(jnp.concatenate([a, b]) @ params["We"] + params["be"])
+
+
+def _decode(params, h):
+    return jnp.tanh(h @ params["Wd"] + params["bd"])
+
+
+def rae_loss(params, plans, l2: float = 1e-4):
+    """Mean per-internal-node reconstruction error over stacked plans."""
+    dim = params["E"].shape[1]
+
+    def one(plan):
+        n_steps = plan["is_leaf"].shape[0]
+        buf0 = jnp.zeros((n_steps, dim), params["E"].dtype)
+
+        def step(carry, i):
+            buf, err = carry
+            a = buf[plan["left"][i]]
+            b = buf[plan["right"][i]]
+            enc = _encode(params, a, b)
+            vec = jnp.where(plan["is_leaf"][i],
+                            params["E"][plan["word_id"][i]], enc)
+            rec = _decode(params, enc)
+            node_err = jnp.sum((rec - jnp.concatenate([a, b])) ** 2)
+            internal = jnp.logical_and(~plan["is_leaf"][i], plan["valid"][i])
+            err = err + jnp.where(internal, node_err, 0.0)
+            return (buf.at[i].set(vec), err), None
+
+        (buf, err), _ = lax.scan(step, (buf0, jnp.asarray(0.0)),
+                                 jnp.arange(n_steps))
+        n_internal = jnp.maximum(
+            jnp.sum((~plan["is_leaf"] & plan["valid"]).astype(jnp.float32)),
+            1.0)
+        return err / n_internal
+
+    loss = jnp.mean(jax.vmap(one)(plans))
+    return loss + l2 * (jnp.sum(params["We"] ** 2) +
+                        jnp.sum(params["Wd"] ** 2))
+
+
+class RecursiveAutoEncoder:
+    """Greedy tree RAE trained with AdaGrad, mirroring the RNTN driver."""
+
+    def __init__(self, dim: int = 16, max_nodes: int = 64, lr: float = 0.05,
+                 l2: float = 1e-4, seed: int = 0):
+        self.dim = dim
+        self.max_nodes = max_nodes
+        self.lr = lr
+        self.l2 = l2
+        self.seed = seed
+        self.vocab: Dict[str, int] = {"<unk>": 0}
+        self.params = None
+        self._hist = None
+
+    def _prepare(self, trees):
+        trees = [parse_tree(t) if isinstance(t, str) else t for t in trees]
+        for t in trees:
+            for tok in tree_tokens(t):
+                if tok not in self.vocab:
+                    self.vocab[tok] = len(self.vocab)
+        return trees
+
+    def fit(self, trees: Sequence["str | TreeNode"], epochs: int = 50
+            ) -> float:
+        trees = self._prepare(trees)
+        if self.params is None:
+            self.params = init_rae_params(jax.random.PRNGKey(self.seed),
+                                          len(self.vocab), self.dim)
+            self._hist = jax.tree_util.tree_map(
+                lambda p: jnp.full_like(p, 1e-8), self.params)
+        elif len(self.vocab) > self.params["E"].shape[0]:
+            n_new = len(self.vocab) - self.params["E"].shape[0]
+            r = 1.0 / np.sqrt(self.dim)
+            rows = jax.random.uniform(
+                jax.random.PRNGKey(self.seed + len(self.vocab)),
+                (n_new, self.dim), self.params["E"].dtype, -r, r)
+            self.params["E"] = jnp.concatenate([self.params["E"], rows])
+            self._hist["E"] = jnp.concatenate(
+                [self._hist["E"], jnp.full_like(rows, 1e-8)])
+        plans = stack_plans([plan_tree(t, self.vocab, self.max_nodes)
+                             for t in trees])
+
+        @jax.jit
+        def step(params, hist, plans):
+            loss, g = jax.value_and_grad(rae_loss)(params, plans, self.l2)
+            hist = jax.tree_util.tree_map(lambda h, gi: h + gi ** 2, hist, g)
+            params = jax.tree_util.tree_map(
+                lambda p, gi, h: p - self.lr * gi / jnp.sqrt(h),
+                params, g, hist)
+            return params, hist, loss
+
+        loss = jnp.inf
+        for _ in range(epochs):
+            self.params, self._hist, loss = step(self.params, self._hist,
+                                                 plans)
+        return float(loss)
+
+    def encode(self, tree: "str | TreeNode") -> np.ndarray:
+        """Root embedding of a tree (the learned phrase representation)."""
+        t = parse_tree(tree) if isinstance(tree, str) else tree
+        plan_obj = plan_tree(t, self.vocab, self.max_nodes)
+        plan = {k: jnp.asarray(getattr(plan_obj, k))
+                for k in ("is_leaf", "word_id", "left", "right", "label",
+                          "valid")}
+        dim = self.dim
+        buf = jnp.zeros((self.max_nodes, dim))
+        for i in range(plan_obj.n_nodes):
+            a = buf[int(plan_obj.left[i])]
+            b = buf[int(plan_obj.right[i])]
+            vec = (self.params["E"][int(plan_obj.word_id[i])]
+                   if plan_obj.is_leaf[i] else _encode(self.params, a, b))
+            buf = buf.at[i].set(vec)
+        return np.asarray(buf[plan_obj.n_nodes - 1])
+
+    def reconstruction_error(self, tree: "str | TreeNode") -> float:
+        t = parse_tree(tree) if isinstance(tree, str) else tree
+        plans = stack_plans([plan_tree(t, self.vocab, self.max_nodes)])
+        return float(rae_loss(self.params, plans, l2=0.0))

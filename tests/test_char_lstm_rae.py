@@ -1,0 +1,52 @@
+"""CharLSTM decode paths + recursive autoencoder."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.models.char_lstm import CharLSTM
+from deeplearning4j_tpu.models.recursive_autoencoder import (
+    RecursiveAutoEncoder)
+
+
+@pytest.fixture(scope="module")
+def trained_lm():
+    # deterministic cyclic corpus: "abcd" repeating
+    return CharLSTM(hidden=32, seq_len=8, lr=0.2, iterations=120,
+                    seed=0).fit("abcd" * 100)
+
+
+def test_char_lstm_greedy_sampling_learns_cycle(trained_lm):
+    out = trained_lm.sample("abc", n=8, temperature=0.0)
+    assert out == "dabcdabc", out
+
+
+def test_char_lstm_temperature_sampling_valid_chars(trained_lm):
+    out = trained_lm.sample("ab", n=20, temperature=1.0, rng_seed=3)
+    assert len(out) == 20
+    assert set(out) <= set("abcd")
+
+
+def test_char_lstm_beam_search_decodes_cycle(trained_lm):
+    text, score = trained_lm.beam_search("abc", n=6, beam_width=3)
+    assert text == "dabcda", (text, score)
+    assert score <= 0.0  # total log-probability
+
+
+def test_rae_learns_reconstruction():
+    trees = ["(0 (0 a) (0 b))", "(0 (0 (0 a) (0 b)) (0 c))",
+             "(0 (0 c) (0 (0 a) (0 d)))"]
+    rae = RecursiveAutoEncoder(dim=8, max_nodes=16, lr=0.1, seed=0)
+    loss_first = rae.fit(trees, epochs=1)
+    loss_last = rae.fit(trees, epochs=150)
+    assert loss_last < loss_first * 0.5, (loss_first, loss_last)
+
+
+def test_rae_encodes_and_scores():
+    trees = ["(0 (0 a) (0 b))", "(0 (0 b) (0 c))"]
+    rae = RecursiveAutoEncoder(dim=8, max_nodes=8, lr=0.1, seed=1)
+    rae.fit(trees, epochs=100)
+    vec = rae.encode("(0 (0 a) (0 b))")
+    assert vec.shape == (8,)
+    assert np.isfinite(vec).all()
+    seen = rae.reconstruction_error("(0 (0 a) (0 b))")
+    assert np.isfinite(seen) and seen >= 0
