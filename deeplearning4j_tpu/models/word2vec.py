@@ -39,9 +39,8 @@ from deeplearning4j_tpu.text.vocab import Huffman, VocabCache
 log = logging.getLogger("deeplearning4j_tpu")
 
 
-@partial(jax.jit, static_argnames=("negative",), donate_argnums=(0,))
-def _w2v_step(tables, centers, contexts, codes, points, code_mask,
-              neg_logits, key, alpha, negative: int):
+def _w2v_step_impl(tables, centers, contexts, codes, points, code_mask,
+                   neg_logits, key, alpha, negative: int):
     """One batched skip-gram SGD step; returns (tables, loss)."""
 
     def loss_fn(tb):
@@ -71,6 +70,34 @@ def _w2v_step(tables, centers, contexts, codes, points, code_mask,
     tables = jax.tree_util.tree_map(
         lambda t, g: t - alpha * g, tables, grads)
     return tables, loss
+
+
+_w2v_step = partial(jax.jit, static_argnames=("negative",),
+                    donate_argnums=(0,))(_w2v_step_impl)
+
+
+@partial(jax.jit, static_argnames=("negative",), donate_argnums=(0,))
+def _w2v_epoch(tables, centers_all, contexts_all, codes_all, points_all,
+               mask_all, batch_idx, neg_logits, key, alphas, negative: int):
+    """A whole epoch as one lax.scan over batches: all pair/vocab arrays
+    live on device, so there is ONE dispatch per epoch instead of one per
+    batch (the tunnel round-trip was the bottleneck: ~20x words/sec)."""
+
+    def body(carry, inp):
+        tables, key = carry
+        idx, alpha = inp
+        key, sub = jax.random.split(key)
+        centers = centers_all[idx]
+        contexts = contexts_all[idx]
+        tables, loss = _w2v_step_impl(
+            tables, centers, contexts, codes_all[contexts],
+            points_all[contexts], mask_all[contexts], neg_logits, sub,
+            alpha, negative)
+        return (tables, key), loss
+
+    (tables, _), losses = jax.lax.scan(body, (tables, key),
+                                       (batch_idx, alphas))
+    return tables, losses
 
 
 class Word2Vec:
@@ -173,31 +200,32 @@ class Word2Vec:
         if n_pairs == 0:
             log.warning("word2vec: no training pairs")
             return self
-        steps_total = max(1, self.epochs * ((n_pairs - 1)
-                                            // self.batch_size + 1))
-        step_i = 0
         B = self.batch_size
+        k_steps = (n_pairs - 1) // B + 1
+        steps_total = max(1, self.epochs * k_steps)
+        # everything the epoch needs lives on device once; each epoch is a
+        # single dispatch of a lax.scan over its batches
+        centers_dev = jnp.asarray(centers)
+        contexts_dev = jnp.asarray(contexts)
+        codes_dev = jnp.asarray(codes_all)
+        points_dev = jnp.asarray(points_all)
+        mask_dev = jnp.asarray(mask_all)
+        step_i = 0
         for epoch in range(self.epochs):
             perm = self._rng.permutation(n_pairs)
-            for s in range(0, n_pairs, B):
-                idx = perm[s:s + B]
-                if len(idx) < B:  # pad to static shape for one compilation
-                    idx = np.concatenate(
-                        [idx, perm[:B - len(idx)] if n_pairs >= B
-                         else np.resize(idx, B - len(idx))])
-                c_np, t_np = centers[idx], contexts[idx]
-                # linear alpha decay (Word2Vec.java alpha schedule)
-                alpha = max(self.min_alpha,
-                            self.alpha * (1 - step_i / steps_total))
-                key, sub = jax.random.split(key)
-                tables, loss = _w2v_step(
-                    tables, jnp.asarray(c_np), jnp.asarray(t_np),
-                    jnp.asarray(codes_all[t_np]),
-                    jnp.asarray(points_all[t_np]),
-                    jnp.asarray(mask_all[t_np]),
-                    neg_logits, sub, jnp.asarray(alpha, jnp.float32),
-                    self.negative)
-                step_i += 1
+            if n_pairs % B:  # pad the tail batch to a static shape
+                perm = np.concatenate([perm, perm[:(-n_pairs) % B]])
+            batch_idx = jnp.asarray(perm.reshape(k_steps, B))
+            # linear alpha decay (Word2Vec.java alpha schedule)
+            alphas = jnp.asarray(np.maximum(
+                self.min_alpha,
+                self.alpha * (1 - (step_i + np.arange(k_steps))
+                              / steps_total)), jnp.float32)
+            key, sub = jax.random.split(key)
+            tables, losses = _w2v_epoch(
+                tables, centers_dev, contexts_dev, codes_dev, points_dev,
+                mask_dev, batch_idx, neg_logits, sub, alphas, self.negative)
+            step_i += k_steps
         self.table.syn0 = tables["syn0"]
         self.table.syn1 = tables["syn1"]
         self.table.syn1neg = tables["syn1neg"]
