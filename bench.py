@@ -1,27 +1,109 @@
-"""Benchmark — LeNet-5 MNIST training throughput (BASELINE configs[0]).
+"""Benchmark suite — the full BASELINE matrix + transformer MFU.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Emits ONE JSON line per metric:
+  {"metric", "value", "unit", "vs_baseline", ...}
 
-The reference publishes no numbers (BASELINE.md); `vs_baseline` is computed
-against an assumed 500 samples/sec for the 2015 CPU-jblas ND4J stack on this
-model — the era-typical figure for full LeNet-5 fwd+bwd on a multicore CPU —
-so the ratio is indicative, not a measured A/B.
+Metrics (BASELINE.json):
+  configs[0]  LeNet-5 MNIST          train samples/sec/chip
+  configs[1]  char-LSTM (PTB-style)  train chars/sec/chip
+  configs[3]  Word2Vec skip-gram     words/sec
+  configs[4]  data-parallel MLP      all-reduce step time (ms)
+  flagship    char-transformer LM    MFU (model FLOPs utilization)
+
+The reference publishes no numbers (BASELINE.md); each `vs_baseline` is
+against an *assumed* figure for the 2015 CPU-jblas ND4J stack, labelled in
+the `baseline_note` field — indicative, not a measured A/B.
+
+Resilience (VERDICT r1 "what's weak" #1): the axon TPU tunnel can come up
+UNAVAILABLE (claim contention) or hang outright.  The parent process
+re-execs itself with a per-attempt wall-clock timeout and bounded retries;
+the child additionally retries backend init with backoff, clearing failed
+backends between attempts.
 """
 
 from __future__ import annotations
 
 import json
+import os
+import subprocess
+import sys
 import time
 
 import numpy as np
 
-ASSUMED_REFERENCE_SAMPLES_PER_SEC = 500.0
-BATCH = 4096  # large-batch TPU regime: saturates the MXU (256 leaves ~20x idle)
-WARMUP_STEPS = 5
-MEASURE_STEPS = 120  # long chain amortizes dispatch; host read closes it
+_CHILD_ENV = "DL4J_BENCH_CHILD"
+ATTEMPT_TIMEOUT_S = 1500
+MAX_ATTEMPTS = 3
+RETRY_PAUSE_S = 45
+# smoke-test mode: tiny shapes/steps so the suite runs in seconds on CPU
+SMALL = os.environ.get("DL4J_BENCH_SMALL") == "1"
 
 
-def main() -> None:
+def _emit(metric: str, value: float, unit: str, vs_baseline, **extra) -> None:
+    line = {"metric": metric, "value": round(float(value), 4), "unit": unit,
+            "vs_baseline": (round(float(vs_baseline), 4)
+                            if vs_baseline is not None else None)}
+    line.update(extra)
+    print(json.dumps(line), flush=True)
+
+
+def _devices_with_retry(max_wait: float = 600.0):
+    """jax.devices() with bounded retry/backoff.
+
+    Backend-init failures (tunnel claim contention -> UNAVAILABLE) are
+    cached by jax, so each retry clears the failed backend first."""
+    import jax
+
+    platform = os.environ.get("DL4J_BENCH_PLATFORM")
+    if platform:  # test hook: JAX_PLATFORMS env alone does not stop the
+        jax.config.update("jax_platforms", platform)  # axon plugin here
+    deadline = time.time() + max_wait
+    delay = 5.0
+    while True:
+        try:
+            devs = jax.devices()
+            if devs:
+                return devs
+            raise RuntimeError("no devices")
+        except Exception as e:  # noqa: BLE001 — init errors vary by plugin
+            if time.time() >= deadline:
+                raise
+            print(f"bench: backend init failed ({e!r}); retrying",
+                  file=sys.stderr, flush=True)
+            try:
+                from jax._src import xla_bridge as xb
+
+                xb._clear_backends()
+            except Exception:
+                pass
+            time.sleep(min(delay, max(0.0, deadline - time.time())))
+            delay = min(delay * 1.7, 60.0)
+
+
+def _host_sync(tree) -> float:
+    """Close an async dispatch chain with a host read.
+
+    Through the axon tunnel `block_until_ready` can return before
+    execution completes (measured ~50x inflated throughput) — a host
+    read of a value data-dependent on the chain is the honest fence."""
+    import jax
+    import jax.numpy as jnp
+
+    leaves = jax.tree_util.tree_leaves(tree)
+    return float(jnp.sum(leaves[0]))
+
+
+def _mixed(conf):
+    """bf16 MXU operands / f32 master weights (+23% measured on LeNet)."""
+    return conf.replace(confs=tuple(c.replace(compute_dtype="bfloat16")
+                                    for c in conf.confs))
+
+
+# ---------------------------------------------------------------------------
+# configs[0] — LeNet-5 MNIST
+# ---------------------------------------------------------------------------
+
+def bench_lenet(devs) -> None:
     import jax
     import jax.numpy as jnp
 
@@ -30,45 +112,305 @@ def main() -> None:
     from deeplearning4j_tpu.parallel.data_parallel import DataParallelTrainer
     from deeplearning4j_tpu.parallel.mesh import make_mesh, shard_batch
 
-    n_dev = len(jax.devices())
+    batch, warmup, steps = (64, 1, 4) if SMALL else (4096, 5, 120)
+    n_dev = len(devs)
     mesh = make_mesh({"dp": n_dev})
-    conf = lenet5()
-    # mixed precision: f32 master weights, bf16 MXU operands (+23%
-    # measured at matched convergence on this model)
-    conf = conf.__class__(
-        confs=tuple(c.replace(compute_dtype="bfloat16") for c in conf.confs),
-        pretrain=conf.pretrain, backprop=conf.backprop,
-        input_preprocessors=conf.input_preprocessors)
+    conf = _mixed(lenet5())
     net = MultiLayerNetwork(conf, seed=0).init()
     trainer = DataParallelTrainer(net, mesh, mode="sync")
 
     rng = np.random.RandomState(0)
-    x = jnp.asarray(rng.rand(BATCH, 784), jnp.float32)
-    y = jnp.asarray(np.eye(10, dtype=np.float32)[rng.randint(0, 10, BATCH)])
+    x = jnp.asarray(rng.rand(batch, 784), jnp.float32)
+    y = jnp.asarray(np.eye(10, dtype=np.float32)[rng.randint(0, 10, batch)])
     x, y = shard_batch(mesh, (x, y), "dp")
 
     key = jax.random.PRNGKey(0)
-    for _ in range(WARMUP_STEPS):
-        trainer.state, s = trainer._step(trainer.state, x, y, key)
-    # force a host read: on tunneled platforms block_until_ready can return
-    # before the chain executes, inflating throughput ~50x (measured)
-    float(jnp.sum(trainer.state.params[0]["W"]))
+    for _ in range(warmup):
+        trainer.state, _ = trainer._step(trainer.state, x, y, key)
+    _host_sync(trainer.state.params)
 
     t0 = time.perf_counter()
-    for _ in range(MEASURE_STEPS):
-        trainer.state, s = trainer._step(trainer.state, x, y, key)
-    float(jnp.sum(trainer.state.params[0]["W"]))  # close the chain honestly
+    for _ in range(steps):
+        trainer.state, _ = trainer._step(trainer.state, x, y, key)
+    _host_sync(trainer.state.params)
     dt = time.perf_counter() - t0
 
-    samples_per_sec = MEASURE_STEPS * BATCH / dt
-    per_chip = samples_per_sec / n_dev
-    print(json.dumps({
-        "metric": "LeNet5-MNIST train samples/sec/chip",
-        "value": round(per_chip, 2),
-        "unit": "samples/sec/chip",
-        "vs_baseline": round(per_chip / ASSUMED_REFERENCE_SAMPLES_PER_SEC, 3),
-    }))
+    per_chip = steps * batch / dt / n_dev
+    assumed = 500.0
+    _emit("LeNet5-MNIST train samples/sec/chip", per_chip,
+          "samples/sec/chip", per_chip / assumed,
+          baseline_note=f"assumed {assumed:g} samples/sec, 2015 CPU-jblas")
+
+
+# ---------------------------------------------------------------------------
+# configs[1] — char-LSTM (PTB-style)
+# ---------------------------------------------------------------------------
+
+def bench_char_lstm(devs) -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.models.zoo import char_lstm
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.parallel.data_parallel import DataParallelTrainer
+    from deeplearning4j_tpu.parallel.mesh import make_mesh, shard_batch
+
+    vocab, hidden, seq, batch = ((50, 32, 16, 8) if SMALL else
+                                 (50, 256, 64, 256))  # PTB-ish char setup
+    warmup, steps = (1, 2) if SMALL else (3, 40)
+    n_dev = len(devs)
+    mesh = make_mesh({"dp": n_dev})
+    conf = _mixed(char_lstm(vocab, hidden=hidden, n_layers=1))
+    net = MultiLayerNetwork(conf, seed=0).init()
+    trainer = DataParallelTrainer(net, mesh, mode="sync")
+
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, vocab, (batch, seq + 1))
+    x = jnp.asarray(np.eye(vocab, dtype=np.float32)[ids[:, :-1]])
+    y = jnp.asarray(np.eye(vocab, dtype=np.float32)[ids[:, 1:]]
+                    .reshape(batch * seq, vocab))
+    x, y = shard_batch(mesh, (x, y), "dp")
+
+    key = jax.random.PRNGKey(0)
+    for _ in range(warmup):
+        trainer.state, _ = trainer._step(trainer.state, x, y, key)
+    _host_sync(trainer.state.params)
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        trainer.state, _ = trainer._step(trainer.state, x, y, key)
+    _host_sync(trainer.state.params)
+    dt = time.perf_counter() - t0
+
+    chars_per_sec = steps * batch * seq / dt / n_dev
+    # reference LSTM.java:161-228 is a scalar per-timestep java loop;
+    # era-typical full BPTT on CPU ~ a few k chars/sec
+    assumed = 5000.0
+    _emit("charLSTM-PTB train chars/sec/chip", chars_per_sec,
+          "chars/sec/chip", chars_per_sec / assumed,
+          baseline_note=f"assumed {assumed:g} chars/sec, 2015 CPU scalar "
+                        "BPTT loop")
+
+
+# ---------------------------------------------------------------------------
+# configs[3] — Word2Vec skip-gram + negative sampling
+# ---------------------------------------------------------------------------
+
+def bench_word2vec(devs) -> None:
+    from deeplearning4j_tpu.models.word2vec import Word2Vec
+
+    rng = np.random.RandomState(0)
+    vocab_n, n_tokens, sent_len, epochs = ((200, 4000, 20, 1) if SMALL else
+                                           (2000, 120_000, 20, 3))
+    # zipf-ish unigram draw: realistic subsampling + negative table shape
+    freq = 1.0 / np.arange(1, vocab_n + 1)
+    probs = freq / freq.sum()
+    tokens = rng.choice(vocab_n, size=n_tokens, p=probs)
+    words = np.array([f"w{i}" for i in range(vocab_n)])
+    sents = [list(words[tokens[i:i + sent_len]])
+             for i in range(0, n_tokens, sent_len)]
+
+    w2v = Word2Vec(vector_length=128, window=5, negative=5,
+                   min_word_frequency=1, epochs=epochs, seed=0)
+    t0 = time.perf_counter()
+    w2v.fit(sents)
+    _host_sync(w2v.table.syn0)
+    dt = time.perf_counter() - t0
+
+    words_per_sec = n_tokens * epochs / dt
+    # word2vec.c on a 2015 multicore CPU: ~100k words/sec; DL4J's java
+    # HogWild (InMemoryLookupTable.iterateSample) era-typical ~50k
+    assumed = 50_000.0
+    _emit("Word2Vec skipgram words/sec", words_per_sec, "words/sec",
+          words_per_sec / assumed,
+          baseline_note=f"assumed {assumed:g} words/sec, 2015 CPU HogWild")
+
+
+# ---------------------------------------------------------------------------
+# configs[4] — data-parallel MLP all-reduce step time
+# ---------------------------------------------------------------------------
+
+def bench_dp_allreduce(devs) -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.models.zoo import mlp
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.parallel.data_parallel import DataParallelTrainer
+    from deeplearning4j_tpu.parallel.mesh import make_mesh, shard_batch
+
+    batch, warmup, steps = (64, 1, 4) if SMALL else (8192, 5, 60)
+    n_dev = len(devs)
+    mesh = make_mesh({"dp": n_dev})
+    conf = mlp(784, [512, 512], 10)
+    net = MultiLayerNetwork(conf, seed=0).init()
+    trainer = DataParallelTrainer(net, mesh, mode="sync")
+
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.rand(batch, 784), jnp.float32)
+    y = jnp.asarray(np.eye(10, dtype=np.float32)[rng.randint(0, 10, batch)])
+    x, y = shard_batch(mesh, (x, y), "dp")
+
+    key = jax.random.PRNGKey(0)
+    for _ in range(warmup):
+        trainer.state, _ = trainer._step(trainer.state, x, y, key)
+    _host_sync(trainer.state.params)
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        trainer.state, _ = trainer._step(trainer.state, x, y, key)
+    _host_sync(trainer.state.params)
+    ms = (time.perf_counter() - t0) / steps * 1e3
+
+    # reference round = broadcast whole params + fit + shuffle-average on
+    # Spark local[8] (SparkDl4jMultiLayer.java:157-210); era-typical ~1s
+    assumed_ms = 1000.0
+    _emit("DP-MLP all-reduce step time", ms, "ms/step",
+          assumed_ms / ms,  # >1 = faster than baseline
+          n_devices=n_dev,
+          baseline_note=f"assumed {assumed_ms:g} ms/round, Spark local[8]; "
+                        "vs_baseline = speedup")
+
+
+# ---------------------------------------------------------------------------
+# flagship — char-transformer MFU
+# ---------------------------------------------------------------------------
+
+_PEAK_BF16_FLOPS = (  # per chip; substring-matched against device_kind
+    ("v6", 918e12), ("v5p", 459e12), ("v5 lite", 197e12), ("v5e", 197e12),
+    ("v5", 459e12), ("v4", 275e12), ("v3", 123e12), ("v2", 45e12),
+)
+
+
+def _peak_flops(device_kind: str):
+    kind = device_kind.lower()
+    for tag, peak in _PEAK_BF16_FLOPS:
+        if tag in kind:
+            return peak
+    return None
+
+
+def bench_transformer_mfu(devs) -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.models.zoo import char_transformer
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.parallel.data_parallel import DataParallelTrainer
+    from deeplearning4j_tpu.parallel.mesh import make_mesh, shard_batch
+
+    vocab, d_model, blocks, heads, seq = ((64, 64, 1, 4, 32) if SMALL else
+                                          (256, 512, 4, 8, 256))
+    batch, warmup, steps = ((2 * len(devs), 1, 2) if SMALL
+                            else (32 * len(devs), 3, 30))
+    mesh = make_mesh({"dp": len(devs)})
+    conf = _mixed(char_transformer(vocab, d_model=d_model, n_blocks=blocks,
+                                   n_heads=heads, max_seq_len=seq))
+    net = MultiLayerNetwork(conf, seed=0).init()
+    trainer = DataParallelTrainer(net, mesh, mode="sync")
+
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, vocab, (batch, seq + 1))
+    x = jnp.asarray(ids[:, :-1], jnp.int32)
+    y = jnp.asarray(np.eye(vocab, dtype=np.float32)[ids[:, 1:]]
+                    .reshape(batch * seq, vocab))
+    x, y = shard_batch(mesh, (x, y), "dp")
+
+    key = jax.random.PRNGKey(0)
+    for _ in range(warmup):
+        trainer.state, _ = trainer._step(trainer.state, x, y, key)
+    _host_sync(trainer.state.params)
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        trainer.state, _ = trainer._step(trainer.state, x, y, key)
+    _host_sync(trainer.state.params)
+    dt_step = (time.perf_counter() - t0) / steps
+
+    # analytic train FLOPs: 6*P*tokens for matmul params + attention
+    # scores/values (12*S^2*d per token per block, fwd+bwd)
+    n_params = sum(int(np.prod(p.shape))
+                   for p in jax.tree_util.tree_leaves(trainer.state.params))
+    tokens = batch * seq
+    flops = 6.0 * n_params * tokens + 12.0 * blocks * tokens * seq * d_model
+    try:  # prefer XLA's own count when exposed
+        cost = trainer._step.lower(
+            trainer.state, x, y, key).compile().cost_analysis()
+        cost = cost[0] if isinstance(cost, (list, tuple)) else cost
+        xla_flops = float(cost.get("flops", 0.0))
+        # XLA counts fwd+bwd of the compiled program directly
+        if xla_flops > 0:
+            flops = xla_flops
+    except Exception:
+        pass
+
+    achieved = flops / dt_step
+    peak = _peak_flops(devs[0].device_kind)
+    if peak is not None:
+        mfu = achieved / (peak * len(devs))
+        _emit("charTransformer train MFU", mfu, "fraction of peak", None,
+              achieved_tflops=round(achieved / 1e12, 2),
+              peak_tflops_per_chip=round(peak / 1e12, 1),
+              device_kind=devs[0].device_kind,
+              tokens_per_sec=round(tokens / dt_step, 1))
+    else:
+        _emit("charTransformer train FLOPs/sec", achieved, "FLOP/s", None,
+              device_kind=devs[0].device_kind,
+              tokens_per_sec=round(tokens / dt_step, 1))
+
+
+# ---------------------------------------------------------------------------
+
+def run_child() -> int:
+    devs = _devices_with_retry()
+    print(f"bench: {len(devs)} device(s), kind={devs[0].device_kind}",
+          file=sys.stderr, flush=True)
+    benches = [bench_lenet, bench_char_lstm, bench_word2vec,
+               bench_dp_allreduce, bench_transformer_mfu]
+    ok = 0
+    for b in benches:
+        try:
+            b(devs)
+            ok += 1
+        except Exception as e:  # noqa: BLE001 — report, keep going
+            import traceback
+
+            print(f"bench: {b.__name__} failed: {e!r}", file=sys.stderr)
+            traceback.print_exc()
+    return 0 if ok else 1
+
+
+def main() -> int:
+    if os.environ.get(_CHILD_ENV) == "1":
+        return run_child()
+    # parent: per-attempt wall-clock timeout guards against tunnel hangs
+    env = dict(os.environ)
+    env[_CHILD_ENV] = "1"
+    for attempt in range(1, MAX_ATTEMPTS + 1):
+        try:
+            proc = subprocess.run(
+                [sys.executable, os.path.abspath(__file__)], env=env,
+                cwd=os.path.dirname(os.path.abspath(__file__)) or ".",
+                capture_output=True, text=True, timeout=ATTEMPT_TIMEOUT_S)
+        except subprocess.TimeoutExpired as e:
+            print(f"bench attempt {attempt}: timed out after "
+                  f"{ATTEMPT_TIMEOUT_S}s\n{e.stderr or ''}", file=sys.stderr)
+        else:
+            sys.stderr.write(proc.stderr or "")
+            if proc.returncode == 0 and proc.stdout.strip():
+                sys.stdout.write(proc.stdout)
+                return 0
+            print(f"bench attempt {attempt}: rc={proc.returncode}",
+                  file=sys.stderr)
+            if attempt == MAX_ATTEMPTS:
+                # last chance: surface whatever partial metrics exist
+                # (earlier failed attempts stay quiet so a later success
+                # can't produce duplicate metric lines)
+                sys.stdout.write(proc.stdout or "")
+        if attempt < MAX_ATTEMPTS:
+            time.sleep(RETRY_PAUSE_S)
+    return 1
 
 
 if __name__ == "__main__":
-    main()
+    sys.exit(main())

@@ -65,8 +65,19 @@ def _zoo_conf(spec: str, data):
         return zoo.char_lstm(vocab, hidden=int(kw.get("hidden", 128)),
                              n_layers=int(kw.get("layers", 1)), lr=lr,
                              iterations=iters)
-    raise SystemExit(f"unknown --zoo model '{name}' "
-                     "(choose lenet5, mlp, char_lstm)")
+    if name == "char_transformer":
+        vocab = getattr(data, "vocab_size", data.features.shape[-1])
+        seq = getattr(data, "seq_len", 0) or int(kw.get("seq_len", 256))
+        return zoo.char_transformer(
+            vocab, d_model=int(kw.get("d_model", 128)),
+            n_blocks=int(kw.get("blocks", 2)),
+            n_heads=int(kw.get("heads", 4)), max_seq_len=seq,
+            lr=float(kw.get("lr", 1e-3)), iterations=iters)
+    if name == "vgg_cifar10":
+        return zoo.vgg_cifar10(lr=lr, iterations=iters,
+                               width=int(kw.get("width", 64)))
+    raise SystemExit(f"unknown --zoo model '{name}' (choose lenet5, mlp, "
+                     "char_lstm, char_transformer, vgg_cifar10)")
 
 
 def cmd_train(args) -> int:
@@ -84,6 +95,17 @@ def cmd_train(args) -> int:
             conf = MultiLayerConfiguration.from_json(f.read())
     else:
         raise SystemExit("train needs --model <conf.json> or --zoo <name>")
+    from deeplearning4j_tpu.nn.conf import LayerType
+    if (LayerType(str(conf.confs[0].layer_type)) == LayerType.EMBEDDING
+            and data.features.ndim == 3):
+        # embedding layers consume integer ids [B,T]; text-scheme input
+        # arrives one-hot [B,T,V] — convert by mechanism, not model name
+        from deeplearning4j_tpu.datasets.dataset import DataSet
+        ds = DataSet(data.features.argmax(-1).astype("int32"), data.labels)
+        for attr in ("vocab_size", "seq_len", "index_to_char"):
+            if hasattr(data, attr):
+                setattr(ds, attr, getattr(data, attr))
+        data = ds
     if args.normalize:
         data = data.normalize_zero_mean_unit_variance()
 

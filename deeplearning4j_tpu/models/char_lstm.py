@@ -46,6 +46,10 @@ class CharLSTM:
         self.char_index = {c: i for i, c in enumerate(self.chars)}
         v = len(self.chars)
         ids = self._encode(text)
+        if len(ids) < self.seq_len + 1:
+            raise ValueError(
+                f"text too short for seq_len={self.seq_len}: need at least "
+                f"{self.seq_len + 1} chars, got {len(ids)}")
         n_win = max(1, (len(ids) - 1) // self.seq_len)
         xs = ids[:n_win * self.seq_len].reshape(n_win, self.seq_len)
         ys = ids[1:n_win * self.seq_len + 1].reshape(-1)
@@ -132,6 +136,9 @@ class CharLSTM:
         assert self.net is not None, "fit() first"
         step = self._step_fn()
         v = len(self.chars)
+        # more beams than characters would leave hs/cs rows without a
+        # matching candidate on the next step()
+        beam_width = min(beam_width, v)
         eye = jnp.eye(v)
         hs, cs = self._init_state(1)
         logp, hs, cs = self._feed(step, seed_text, hs, cs)

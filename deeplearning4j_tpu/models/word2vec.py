@@ -213,8 +213,10 @@ class Word2Vec:
         step_i = 0
         for epoch in range(self.epochs):
             perm = self._rng.permutation(n_pairs)
-            if n_pairs % B:  # pad the tail batch to a static shape
-                perm = np.concatenate([perm, perm[:(-n_pairs) % B]])
+            if n_pairs % B:  # pad the tail batch to a static shape; resize
+                # wraps cyclically, so it works even when the pad needed
+                # exceeds n_pairs (tiny corpus, n_pairs < B)
+                perm = np.resize(perm, k_steps * B)
             batch_idx = jnp.asarray(perm.reshape(k_steps, B))
             # linear alpha decay (Word2Vec.java alpha schedule)
             alphas = jnp.asarray(np.maximum(

@@ -123,12 +123,14 @@ def vgg_cifar10(lr: float = 0.05, iterations: int = 1,
 
 def char_transformer(vocab: int, d_model: int = 128, n_blocks: int = 2,
                      n_heads: int = 4, max_seq_len: int = 256,
-                     lr: float = 0.1, iterations: int = 1
-                     ) -> MultiLayerConfiguration:
+                     lr: float = 0.1, iterations: int = 1,
+                     updater: str = "adam") -> MultiLayerConfiguration:
     """Decoder-only char transformer LM (new scope — the reference's only
     sequence model is the scalar-loop LSTM).  Embedding (+ learned
-    positions) -> n_blocks x [causal MHA, FFN] -> per-token softmax."""
-    b = _base(lr=lr, iters=iterations)
+    positions) -> n_blocks x [causal MHA, FFN] -> per-token softmax.
+    Trains with Adam by default (the flagship wants it; plain SGD+momentum
+    trains transformers poorly)."""
+    b = _base(lr=lr, iters=iterations, updater=updater)
     confs = [b.replace(layer_type=LayerType.EMBEDDING, n_in=vocab,
                        n_out=d_model, max_seq_len=max_seq_len)]
     for _ in range(n_blocks):

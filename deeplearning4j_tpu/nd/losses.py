@@ -8,8 +8,10 @@ by `OutputLayer.java:77-90` and the per-loss gradient algebra at
 `OutputLayer.java:126-158`.
 
 TPU-native design: each loss is a pure `(labels, output) -> scalar mean`
-function; gradients come from `jax.grad` end-to-end instead of the
-reference's hand-derived per-loss weight-gradient formulas.  All math is
+function built from a per-example `rowwise` form; gradients come from
+`jax.grad` end-to-end instead of the reference's hand-derived per-loss
+weight-gradient formulas.  The rowwise forms back sample-weighted /
+pad-masked training (remainder batches on a dp mesh).  All math is
 numerically stabilized (clipped logs) and runs in whatever dtype the inputs
 carry (bfloat16-friendly: reductions accumulate in float32).
 """
@@ -46,49 +48,79 @@ def _f32(x: jnp.ndarray) -> jnp.ndarray:
     return x.astype(jnp.float32)
 
 
-def mcxent(labels, output):
-    return -jnp.mean(jnp.sum(_f32(labels) * jnp.log(_clip(_f32(output))), axis=-1))
+# -- per-example forms (last axis reduced; leading axes preserved) ----------
+
+def mcxent_rows(labels, output):
+    return -jnp.sum(_f32(labels) * jnp.log(_clip(_f32(output))), axis=-1)
 
 
-def xent(labels, output):
+def xent_rows(labels, output):
     y, p = _f32(labels), _clip(_f32(output))
-    return -jnp.mean(jnp.sum(y * jnp.log(p) + (1.0 - y) * jnp.log(1.0 - p), axis=-1))
+    return -jnp.sum(y * jnp.log(p) + (1.0 - y) * jnp.log(1.0 - p), axis=-1)
 
 
-def mse(labels, output):
+def mse_rows(labels, output):
     d = _f32(labels) - _f32(output)
-    return 0.5 * jnp.mean(jnp.sum(d * d, axis=-1))
+    return 0.5 * jnp.sum(d * d, axis=-1)
 
 
-def expll(labels, output):
+def expll_rows(labels, output):
     p = _clip(_f32(output))
-    return jnp.mean(jnp.sum(p - _f32(labels) * jnp.log(p), axis=-1))
+    return jnp.sum(p - _f32(labels) * jnp.log(p), axis=-1)
 
 
-def rmse_xent(labels, output):
+def rmse_xent_rows(labels, output):
     d = _f32(labels) - _f32(output)
-    return jnp.mean(jnp.sqrt(jnp.sum(d * d, axis=-1) + _EPS))
+    return jnp.sqrt(jnp.sum(d * d, axis=-1) + _EPS)
 
 
-def squared_loss(labels, output):
+def squared_loss_rows(labels, output):
     d = _f32(labels) - _f32(output)
-    return jnp.mean(jnp.sum(d * d, axis=-1))
+    return jnp.sum(d * d, axis=-1)
 
 
-def negativeloglikelihood(labels, output):
-    return mcxent(labels, output)
-
-
-def reconstruction_crossentropy(labels, output):
-    return xent(labels, output)
-
-
-def cosine_proximity(labels, output):
+def cosine_proximity_rows(labels, output):
     y, p = _f32(labels), _f32(output)
     yn = y / (jnp.linalg.norm(y, axis=-1, keepdims=True) + _EPS)
     pn = p / (jnp.linalg.norm(p, axis=-1, keepdims=True) + _EPS)
-    return -jnp.mean(jnp.sum(yn * pn, axis=-1))
+    return -jnp.sum(yn * pn, axis=-1)
 
+
+_ROWWISE = {
+    LossFunction.MCXENT: mcxent_rows,
+    LossFunction.XENT: xent_rows,
+    LossFunction.MSE: mse_rows,
+    LossFunction.EXPLL: expll_rows,
+    LossFunction.RMSE_XENT: rmse_xent_rows,
+    LossFunction.SQUARED_LOSS: squared_loss_rows,
+    LossFunction.NEGATIVELOGLIKELIHOOD: mcxent_rows,
+    LossFunction.RECONSTRUCTION_CROSSENTROPY: xent_rows,
+    LossFunction.COSINE_PROXIMITY: cosine_proximity_rows,
+}
+
+
+def get_rowwise(fn) -> callable:
+    """Per-example loss `(labels, output) -> [batch]` for sample weighting."""
+    return _ROWWISE[LossFunction(str(fn).lower())]
+
+
+# -- batch-mean forms (the reference's scoring surface) ---------------------
+
+def _mean_of(rows_fn):
+    def f(labels, output):
+        return jnp.mean(rows_fn(labels, output))
+    return f
+
+
+mcxent = _mean_of(mcxent_rows)
+xent = _mean_of(xent_rows)
+mse = _mean_of(mse_rows)
+expll = _mean_of(expll_rows)
+rmse_xent = _mean_of(rmse_xent_rows)
+squared_loss = _mean_of(squared_loss_rows)
+negativeloglikelihood = _mean_of(mcxent_rows)
+reconstruction_crossentropy = _mean_of(xent_rows)
+cosine_proximity = _mean_of(cosine_proximity_rows)
 
 _LOSSES = {
     LossFunction.MCXENT: mcxent,

@@ -128,7 +128,22 @@ class NeuralNetConfiguration:
     constrain_gradient_to_unit_norm: bool = False
     gradient_clip_norm: float = 0.0  # 0 = off (new capability)
     minimize: bool = True
-    step_function: str = "default"
+    step_function: str = "default"  # default | gradient | negative_default
+                                    # | negative_gradient (stepfunctions/*)
+    # pluggable termination conditions (ref optimize/terminations/*):
+    # any of "eps" (EpsTermination), "norm2" (Norm2Termination),
+    # "zero_direction" (ZeroDirection); empty tuple = run all iterations
+    termination_conditions: Tuple[str, ...] = ("eps", "norm2")
+    termination_eps: float = 1e-6
+    termination_norm2: float = 1e-8
+    # updater selection: "" = legacy chain (use_adagrad flag + momentum),
+    # or one of sgd | adagrad | nesterov | adam | rmsprop (parity-plus:
+    # the reference stops at AdaGrad/momentum, GradientAdjustment.java:159)
+    updater: str = ""
+    adam_beta1: float = 0.9
+    adam_beta2: float = 0.999
+    adam_eps: float = 1e-8
+    rmsprop_decay: float = 0.95
     num_line_search_iterations: int = 20
     lbfgs_memory: int = 4          # two-loop history (LBFGS.java m=4)
     hf_cg_iterations: int = 32     # inner CG trip count (Martens HF)
@@ -153,6 +168,9 @@ class NeuralNetConfiguration:
     ffn_hidden: int = 0            # transformer FFN width (0 = 4*n_in)
     max_seq_len: int = 0           # >0: learned positional embedding table
     lstm_impl: str = "auto"        # auto | scan | fused (pallas cell)
+
+    # batch-norm running-stat decay (ema = m*ema + (1-m)*batch)
+    batch_norm_momentum: float = 0.9
 
     # conv knobs (NCHW)
     kernel_size: Tuple[int, int] = (5, 5)
@@ -206,7 +224,8 @@ class NeuralNetConfiguration:
         for k in ("momentum_after",):
             if k in d and d[k] is not None:
                 d[k] = tuple(tuple(x) for x in d[k])
-        for k in ("kernel_size", "stride", "padding"):
+        for k in ("kernel_size", "stride", "padding",
+                  "termination_conditions"):
             if k in d and d[k] is not None:
                 d[k] = tuple(d[k])
         known = {f.name for f in dataclasses.fields(cls)}

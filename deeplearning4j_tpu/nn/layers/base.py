@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from deeplearning4j_tpu.nd import random as ndr
 from deeplearning4j_tpu.nd.ops import activate
@@ -81,8 +82,13 @@ class BatchNormLayer:
         return {
             "gamma": jnp.ones((n,), d),
             "beta": jnp.zeros((n,), d),
+            # bias-corrected running stats: raw EMA accumulators plus the
+            # total EMA weight (1 - m^k); inference divides by ema_w so one
+            # training batch already yields exact stats and the estimate is
+            # never dominated by whichever batch came last
             "ema_mean": jnp.zeros((n,), d),
-            "ema_var": jnp.ones((n,), d),
+            "ema_var": jnp.zeros((n,), d),
+            "ema_w": jnp.zeros((), d),
         }
 
     @staticmethod
@@ -92,14 +98,49 @@ class BatchNormLayer:
         return (0, 2, 3) if x.ndim == 4 else tuple(range(x.ndim - 1))
 
     @staticmethod
-    def forward(params, conf, x, key=None, training=False):
-        eps = 1e-5
+    def moments(x, row_weights=None):
+        """Raw batch moments (s1, s2, cnt) in f32, optionally row-weighted
+        (pad rows of a masked remainder batch weigh 0 and are excluded).
+        mean = s1/cnt, var = s2/cnt - mean^2.  Kept as raw sums so dp
+        shards can psum them into GLOBAL-batch statistics."""
         axes = BatchNormLayer._feature_axes(x)
-        if training:
-            mean = jnp.mean(x, axis=axes)
-            var = jnp.var(x, axis=axes)
+        xf = x.astype(jnp.float32)
+        if row_weights is None:
+            cnt = jnp.asarray(float(np.prod([x.shape[a] for a in axes])),
+                              jnp.float32)
+            s1 = jnp.sum(xf, axis=axes)
+            s2 = jnp.sum(xf * xf, axis=axes)
         else:
-            mean, var = params["ema_mean"], params["ema_var"]
+            w = row_weights.reshape((x.shape[0],) + (1,) * (x.ndim - 1))
+            w = w.astype(jnp.float32)
+            per_row = float(np.prod([x.shape[a] for a in axes if a != 0])
+                            or 1.0)
+            cnt = jnp.sum(w) * per_row
+            s1 = jnp.sum(xf * w, axis=axes)
+            s2 = jnp.sum(xf * xf * w, axis=axes)
+        return s1, s2, cnt
+
+    @staticmethod
+    def stats_of(s1, s2, cnt):
+        """(mean, var) from raw moments."""
+        cnt = jnp.maximum(cnt, 1.0)
+        mean = s1 / cnt
+        var = jnp.maximum(s2 / cnt - mean * mean, 0.0)
+        return mean, var
+
+    @staticmethod
+    def weighted_batch_stats(x, row_weights):
+        """Batch mean/var over real rows only (pad rows weigh 0) — the
+        masked remainder-batch path must not let zero padding skew the
+        statistics the real rows are normalized with."""
+        mean, var = BatchNormLayer.stats_of(
+            *BatchNormLayer.moments(x, row_weights))
+        return mean.astype(x.dtype), var.astype(x.dtype)
+
+    @staticmethod
+    def apply_stats(params, x, mean, var):
+        """Normalize x with the given stats + the layer's affine."""
+        eps = 1e-5
         if x.ndim == 4:
             mean = mean[None, :, None, None]
             var = var[None, :, None, None]
@@ -109,6 +150,24 @@ class BatchNormLayer:
             gamma, beta = params["gamma"], params["beta"]
         xn = (x - mean) / jnp.sqrt(var + eps)
         return xn * gamma + beta
+
+    @staticmethod
+    def forward(params, conf, x, key=None, training=False, row_weights=None):
+        axes = BatchNormLayer._feature_axes(x)
+        if training and row_weights is not None:
+            mean, var = BatchNormLayer.weighted_batch_stats(x, row_weights)
+        elif training:
+            mean = jnp.mean(x, axis=axes)
+            var = jnp.var(x, axis=axes)
+        else:
+            mean, var = params["ema_mean"], params["ema_var"]
+            if "ema_w" in params:  # bias-corrected running estimate
+                ema_w = params["ema_w"]
+                denom = jnp.maximum(ema_w, 1e-8)
+                mean = mean / denom
+                # untrained (ema_w == 0): identity-ish normalization
+                var = jnp.where(ema_w > 0, var / denom, jnp.ones_like(var))
+        return BatchNormLayer.apply_stats(params, x, mean, var)
 
 
 class EmbeddingLayer:

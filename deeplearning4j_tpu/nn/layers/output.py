@@ -51,3 +51,11 @@ class OutputLayer(DenseLayer):
         if conf.use_regularization and conf.l1:
             s = s + conf.l1 * jnp.sum(jnp.abs(params["W"].astype(jnp.float32)))
         return s
+
+    @staticmethod
+    def rowwise_loss(params, conf, x, labels, key=None, training=False):
+        """Per-example loss vector, WITHOUT regularization terms (the caller
+        owns those — they must be counted once per step, not per example).
+        Backs sample-weighted / pad-masked training on remainder batches."""
+        out = OutputLayer.forward(params, conf, x, key, training)
+        return L.get_rowwise(conf.loss_function)(labels, out)

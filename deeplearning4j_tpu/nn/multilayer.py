@@ -98,6 +98,164 @@ def network_loss(conf: MultiLayerConfiguration, params, x, labels, key=None,
     return loss
 
 
+def network_rowwise_loss(conf: MultiLayerConfiguration, params, x, labels,
+                         key=None, training=True, row_weights=None,
+                         return_bn_stats=False):
+    """Per-label-row loss vector, no regularization (see
+    `network_regularization` for that half).  Row count follows `labels`'
+    leading dim — e.g. B*T rows for a char-LSTM whose rnn_to_ff stage
+    flattens time into the batch.
+
+    row_weights (per feature row, pad rows = 0) keeps BATCH_NORM training
+    statistics over real rows only — zero padding must neither skew the
+    normalization nor the loss.
+
+    return_bn_stats=True additionally returns the raw BN moments
+    ((s1, s2, cnt) per BATCH_NORM layer, in layer order) computed during
+    THIS forward, so train steps can maintain running inference stats
+    without a second forward pass (`update_bn_ema_from_stats`)."""
+    from deeplearning4j_tpu.nn.layers.base import BatchNormLayer
+    from deeplearning4j_tpu.nn.layers.output import OutputLayer
+
+    n = conf.n_layers
+    keys = (jax.random.split(key, n) if key is not None else [None] * n)
+    h = x
+    stats = []
+    for i in range(n - 1):
+        c = conf.conf(i)
+        h = apply_preprocessor(conf.preprocessor(i), h)
+        impl = get_layer(c.layer_type)
+        is_bn = LayerType(str(c.layer_type)) == LayerType.BATCH_NORM
+        if is_bn and training and (row_weights is not None
+                                   or return_bn_stats):
+            s1, s2, cnt = BatchNormLayer.moments(h, row_weights)
+            if return_bn_stats:
+                stats.append((s1, s2, cnt))
+            mean, var = BatchNormLayer.stats_of(s1, s2, cnt)
+            h = BatchNormLayer.apply_stats(params[i], h,
+                                           mean.astype(h.dtype),
+                                           var.astype(h.dtype))
+        else:
+            h = impl.forward(params[i], c, h, keys[i], training)
+    out_conf = conf.conf(n - 1)
+    h = apply_preprocessor(conf.preprocessor(n - 1), h)
+    rows = OutputLayer.rowwise_loss(params[n - 1], out_conf, h, labels,
+                                    keys[n - 1], training)
+    if return_bn_stats:
+        return rows, tuple(stats)
+    return rows
+
+
+def has_batchnorm(conf: MultiLayerConfiguration) -> bool:
+    return any(LayerType(str(c.layer_type)) == LayerType.BATCH_NORM
+               for c in conf.confs)
+
+
+def _bn_ema_apply(c, p, mean, var):
+    """One layer's EMA advance: ema = m*ema + (1-m)*batch, plus the total
+    EMA weight used for bias correction at inference."""
+    m = c.batch_norm_momentum
+    p = dict(p)
+    p["ema_mean"] = (m * p["ema_mean"].astype(jnp.float32)
+                     + (1 - m) * mean).astype(p["ema_mean"].dtype)
+    p["ema_var"] = (m * p["ema_var"].astype(jnp.float32)
+                    + (1 - m) * var).astype(p["ema_var"].dtype)
+    if "ema_w" in p:
+        p["ema_w"] = (m * p["ema_w"].astype(jnp.float32)
+                      + (1 - m)).astype(p["ema_w"].dtype)
+    return p
+
+
+def update_bn_ema_from_stats(conf: MultiLayerConfiguration, params, stats,
+                             axis=None):
+    """Advance every BATCH_NORM layer's running stats from the raw moments
+    the loss forward already computed (`network_rowwise_loss(...,
+    return_bn_stats=True)`) — no second forward pass.
+
+    axis: shard_map collective axis — moments are psum'd across dp shards
+    so every shard records GLOBAL-batch statistics.
+    """
+    from deeplearning4j_tpu.nn.layers.base import BatchNormLayer
+
+    bn_idx = [i for i, c in enumerate(conf.confs)
+              if LayerType(str(c.layer_type)) == LayerType.BATCH_NORM]
+    new = list(params)
+    for (s1, s2, cnt), i in zip(stats, bn_idx):
+        if axis is not None:
+            s1 = jax.lax.psum(s1, axis)
+            s2 = jax.lax.psum(s2, axis)
+            cnt = jax.lax.psum(cnt, axis)
+        mean, var = BatchNormLayer.stats_of(s1, s2, cnt)
+        new[i] = _bn_ema_apply(conf.conf(i), new[i], mean, var)
+    return tuple(new)
+
+
+def update_bn_ema(conf: MultiLayerConfiguration, params, x, axis=None,
+                  row_weights=None):
+    """Running-EMA update of every BATCH_NORM layer's inference stats from
+    one training batch via a (partial) forward pass — for host-side training
+    loops that can't thread the stats out of their loss forward (MLN.fit's
+    solver scans).  Compiled train steps should prefer
+    `update_bn_ema_from_stats` (zero extra forwards).
+
+    axis:        shard_map collective axis name — batch stats are psum'd
+                 across dp shards so every shard sees GLOBAL-batch stats.
+    row_weights: optional per-feature-row weights (pad rows of a masked
+                 remainder batch carry 0 — excluded from the stats AND from
+                 the propagated activations' normalization).
+    """
+    if not has_batchnorm(conf):
+        return params
+    from deeplearning4j_tpu.nn.layers.base import BatchNormLayer
+
+    last_bn = max(i for i, c in enumerate(conf.confs)
+                  if LayerType(str(c.layer_type)) == LayerType.BATCH_NORM)
+    new = list(params)
+    h = x
+    for i in range(last_bn + 1):
+        c = conf.conf(i)
+        h = apply_preprocessor(conf.preprocessor(i), h)
+        is_bn = LayerType(str(c.layer_type)) == LayerType.BATCH_NORM
+        if is_bn:
+            s1, s2, cnt = BatchNormLayer.moments(h, row_weights)
+            if axis is not None:
+                s1 = jax.lax.psum(s1, axis)
+                s2 = jax.lax.psum(s2, axis)
+                cnt = jax.lax.psum(cnt, axis)
+            mean, var = BatchNormLayer.stats_of(s1, s2, cnt)
+            new[i] = _bn_ema_apply(c, new[i], mean, var)
+        if i < last_bn:
+            # propagate with batch stats (training=True) — downstream BN
+            # layers must see the inputs training actually produces
+            # (row-weighted so pad rows don't skew the propagation either)
+            if is_bn:
+                h = BatchNormLayer.forward(params[i], c, h, None,
+                                           training=True,
+                                           row_weights=row_weights)
+            else:
+                h = get_layer(c.layer_type).forward(params[i], c, h, None,
+                                                    training=True)
+    return tuple(new)
+
+
+def network_regularization(conf: MultiLayerConfiguration, params):
+    """The regularization half of `network_loss` (L2 across layers + the
+    output layer's L2/L1), as one scalar counted once per step."""
+    out_conf = conf.conf(conf.n_layers - 1)
+    reg = jnp.asarray(0.0, jnp.float32)
+    if not out_conf.use_regularization:
+        return reg
+    if out_conf.l2:
+        for i in range(conf.n_layers):
+            if "W" in params[i]:
+                reg = reg + 0.5 * out_conf.l2 * jnp.sum(
+                    params[i]["W"].astype(jnp.float32) ** 2)
+    if out_conf.l1:
+        reg = reg + out_conf.l1 * jnp.sum(
+            jnp.abs(params[conf.n_layers - 1]["W"].astype(jnp.float32)))
+    return reg
+
+
 class MultiLayerNetwork:
     def __init__(self, conf: MultiLayerConfiguration, seed: Optional[int] = None):
         self.conf = conf
@@ -106,6 +264,7 @@ class MultiLayerNetwork:
         self._key = jax.random.PRNGKey(seed)
         self.params: Optional[tuple] = None
         self.listeners: List = []
+        self._bn_ema_fn = None
 
     # -- lifecycle ---------------------------------------------------------
     def _next_key(self):
@@ -208,36 +367,18 @@ class MultiLayerNetwork:
             batches = [(data, labels)]
         else:
             batches = _as_batches(data)
-        x = None
         for batch in batches:
             x, y = batch if isinstance(batch, tuple) else (batch.features, batch.labels)
             if self.conf.pretrain:
                 self.pretrain(jnp.asarray(x))
             if self.conf.backprop:
                 self.finetune(x, y)
-        if x is not None:
-            self._refresh_batchnorm_stats(jnp.asarray(x))
-
-    def _refresh_batchnorm_stats(self, x) -> None:
-        """Recompute BATCH_NORM running (ema) stats from the last fit batch so
-        inference (training=False) normalizes with data statistics rather
-        than the init-time zeros/ones."""
-        if not any(LayerType(str(c.layer_type)) == LayerType.BATCH_NORM
-                   for c in self.conf.confs):
-            return
-        params = list(self.params)
-        h = x
-        for i, c in enumerate(self.conf.confs):
-            h = apply_preprocessor(self.conf.preprocessor(i), h)
-            if LayerType(str(c.layer_type)) == LayerType.BATCH_NORM:
-                from deeplearning4j_tpu.nn.layers.base import BatchNormLayer
-                axes = BatchNormLayer._feature_axes(h)
-                p = dict(params[i])
-                p["ema_mean"] = jnp.mean(h, axis=axes)
-                p["ema_var"] = jnp.var(h, axis=axes)
-                params[i] = p
-            h = get_layer(c.layer_type).forward(params[i], c, h, None, False)
-        self.params = tuple(params)
+            if has_batchnorm(self.conf):
+                # true running EMA across every fit batch (not a post-hoc
+                # recompute from whatever batch happened to come last)
+                if self._bn_ema_fn is None:
+                    self._bn_ema_fn = jax.jit(partial(update_bn_ema, self.conf))
+                self.params = self._bn_ema_fn(self.params, jnp.asarray(x))
 
     # -- parameter vector (distributed/averaging contract) -----------------
     def params_flat(self) -> jnp.ndarray:

@@ -84,7 +84,45 @@ def from_predict_loss(predict: Callable, loss_of_out: Callable) -> Objective:
     return Objective(grad_and_score=gs, score=loss_fn, gnvp=gnvp)
 
 
+def make_termination(conf):
+    """Build the termination predicate from conf (pluggable parity with
+    `optimize/terminations/*`: EpsTermination, Norm2Termination,
+    ZeroDirection).  An empty `termination_conditions` tuple never
+    terminates early (all iterations run)."""
+    conds = tuple(getattr(conf, "termination_conditions", ("eps", "norm2"))
+                  or ())
+    eps = getattr(conf, "termination_eps", EPS_TERMINATION)
+    n2 = getattr(conf, "termination_norm2", NORM2_TERMINATION)
+
+    def terminated(score, old_score, gnorm, dnorm=None):
+        done = jnp.asarray(False)
+        if "eps" in conds:
+            done = jnp.logical_or(done, jnp.abs(score - old_score) < eps)
+        if "norm2" in conds:
+            done = jnp.logical_or(done, gnorm < n2)
+        if "zero_direction" in conds and dnorm is not None:
+            done = jnp.logical_or(done, dnorm < 1e-12)
+        return done
+
+    return terminated
+
+
+def apply_step(conf, x, d, alpha):
+    """Pluggable step application (parity: `optimize/stepfunctions/*`) —
+    default: x + alpha*d; gradient: x + d; negative variants flip the sign."""
+    sf = (getattr(conf, "step_function", "default") or "default").lower()
+    if sf == "gradient":
+        return x + d
+    if sf == "negative_gradient":
+        return x - d
+    if sf == "negative_default":
+        return x - alpha * d
+    return x + alpha * d
+
+
 def _terminated(score, old_score, gnorm):
+    """Module-default predicate (eps + norm2) — kept for callers without a
+    conf in scope."""
     return jnp.logical_or(
         jnp.abs(score - old_score) < EPS_TERMINATION,
         gnorm < NORM2_TERMINATION,
@@ -94,6 +132,7 @@ def _terminated(score, old_score, gnorm):
 def _sgd(objective: Objective, params0, conf, key):
     """ITERATION_GRADIENT_DESCENT: updater-chain steps, no line search."""
     upd0 = init_updater(params0)
+    terminated = make_termination(conf)
 
     def step(carry, it):
         params, upd, k, done, old_score = carry
@@ -102,14 +141,20 @@ def _sgd(objective: Objective, params0, conf, key):
         adj, upd_new = adjust_gradient(conf, it, grads, params, upd)
         gnorm = jnp.sqrt(sum(jnp.sum(jnp.square(x.astype(jnp.float32)))
                              for x in jax.tree_util.tree_leaves(grads)))
+        dnorm = jnp.sqrt(sum(jnp.sum(jnp.square(x.astype(jnp.float32)))
+                             for x in jax.tree_util.tree_leaves(adj)))
+        # direction is -adj (a descent step), alpha fixed at 1 — the
+        # configured step function still applies (stepfunctions parity)
         new_params = jax.tree_util.tree_map(
-            lambda p, a: p - a.astype(p.dtype), params, adj)
+            lambda p, a: apply_step(conf, p, -a.astype(p.dtype), 1.0),
+            params, adj)
         # masked update once terminated
         params = jax.tree_util.tree_map(
             lambda old, new: jnp.where(done, old, new), params, new_params)
         upd = jax.tree_util.tree_map(
             lambda old, new: jnp.where(done, old, new), upd, upd_new)
-        done = jnp.logical_or(done, _terminated(score, old_score, gnorm))
+        done = jnp.logical_or(done, terminated(score, old_score, gnorm,
+                                               dnorm))
         return (params, upd, k, done, score), score
 
     init = (params0, upd0, key, jnp.asarray(False), jnp.inf)
@@ -133,6 +178,7 @@ def _line_searched(objective: Objective, params0, conf, key, algo):
 
     is_cg = algo == OptimizationAlgorithm.CONJUGATE_GRADIENT
     is_lbfgs = algo == OptimizationAlgorithm.LBFGS
+    terminated = make_termination(conf)
 
     def step(carry, it):
         (x, x_prev, g_prev, d_prev, s_hist, y_hist, hist_n, k, done,
@@ -201,12 +247,14 @@ def _line_searched(objective: Objective, params0, conf, key, algo):
             lambda xx: score_flat(xx, kg), x, d, g, score,
             max_iters=conf.num_line_search_iterations,
             initial_step=trial)
-        x_new = x + alpha * d
+        x_new = apply_step(conf, x, d, alpha)
 
         progressed = alpha > 0
         done_new = jnp.logical_or(
             done,
-            jnp.logical_or(~progressed, _terminated(new_score, old_score, gnorm)))
+            jnp.logical_or(~progressed,
+                           terminated(new_score, old_score, gnorm,
+                                      jnp.linalg.norm(d))))
 
         x_prev_out = jnp.where(done, x_prev, x)
         x_out = jnp.where(done, x, x_new)
@@ -235,6 +283,7 @@ def _hessian_free(objective: Objective, params0, conf, key):
     Levenberg-Marquardt lambda adaptation from the reduction ratio rho.
     """
     x0, unravel = ravel_pytree(params0)
+    terminated = make_termination(conf)
 
     def grad_flat(x, k):
         g, s = objective.grad_and_score(unravel(x), k)
@@ -288,16 +337,20 @@ def _hessian_free(objective: Objective, params0, conf, key):
         d = cg_solve(x, g, lam, 0.95 * d_prev, kg)
         # quadratic-model reduction for the LM rho test
         qm = jnp.vdot(g, d) + 0.5 * jnp.vdot(d, bvp(x, d, lam, kg))
-        new_score = score_flat(x + d, kg)
+        proposal = apply_step(conf, x, d, 1.0)  # stepfunctions parity
+        new_score = score_flat(proposal, kg)
         rho = (new_score - score) / jnp.where(qm >= 0, -1e-10, qm)
         lam = jnp.where(rho > 0.75, lam * (2.0 / 3.0),
                         jnp.where(rho < 0.25, lam * 1.5, lam))
         accept = new_score < score
-        x_new = jnp.where(jnp.logical_or(done, ~accept), x, x + d)
+        x_new = jnp.where(jnp.logical_or(done, ~accept), x, proposal)
         d_prev = jnp.where(done, d_prev, d)
-        out_score = jnp.where(jnp.logical_or(done, ~accept), old_score,
-                              new_score)
-        done = jnp.logical_or(done, _terminated(new_score, old_score, gnorm))
+        # rejected iterations report the evaluated score at x (not
+        # old_score, which starts at +inf and would leak into the trace)
+        out_score = jnp.where(done, old_score,
+                              jnp.where(accept, new_score, score))
+        done = jnp.logical_or(done, terminated(new_score, old_score, gnorm,
+                                               jnp.linalg.norm(d)))
         return (x_new, d_prev, lam, k, done, out_score), out_score
 
     init = (x0, jnp.zeros_like(x0), jnp.asarray(conf.hf_initial_lambda),

@@ -47,9 +47,16 @@ def adjust_gradient(conf, iteration, grads, params, state: UpdaterState):
     The returned value is the *scaled step* (lr folded in), to be subtracted
     from params — matching how `GradientAdjustment` rewrites the raw gradient
     in place before the step function applies it.
+
+    `conf.updater` selects the algorithm; "" keeps the reference chain
+    (AdaGrad flag + scheduled momentum, `GradientAdjustment.java:159-226`),
+    while adam / nesterov / rmsprop are parity-plus (the 2015 reference
+    predates them).  Adam reuses the two state trees: velocity = first
+    moment, adagrad_hist = second moment.
     """
     eps = 1e-8
     lr = conf.lr
+    which = (getattr(conf, "updater", "") or "").lower()
 
     # L2 weight decay on the raw gradient (before adaptive scaling)
     if conf.use_regularization and conf.l2:
@@ -57,22 +64,59 @@ def adjust_gradient(conf, iteration, grads, params, state: UpdaterState):
             lambda g, p: g + conf.l2 * p.astype(g.dtype), grads, params)
 
     hist = state.adagrad_hist
-    if conf.use_adagrad:
-        new_hist = jax.tree_util.tree_map(lambda h, g: h + g * g, hist, grads)
-        if conf.adagrad_reset_iterations > 0:
-            resetting = (iteration % conf.adagrad_reset_iterations) == 0
-            new_hist = jax.tree_util.tree_map(
-                lambda h, g: jnp.where(resetting, g * g, h), new_hist, grads)
-        scaled = jax.tree_util.tree_map(
-            lambda g, h: lr * g / (jnp.sqrt(h) + eps), grads, new_hist)
-        hist = new_hist
-    else:
-        scaled = jax.tree_util.tree_map(lambda g: lr * g, grads)
+    vel = state.velocity
+    if which == "adam":
+        b1, b2 = conf.adam_beta1, conf.adam_beta2
+        t = jnp.asarray(iteration, jnp.float32) + 1.0
+        vel = jax.tree_util.tree_map(
+            lambda m, g: b1 * m + (1 - b1) * g, vel, grads)
+        hist = jax.tree_util.tree_map(
+            lambda v, g: b2 * v + (1 - b2) * g * g, hist, grads)
+        c1 = 1.0 - jnp.power(b1, t)
+        c2 = 1.0 - jnp.power(b2, t)
+        step = jax.tree_util.tree_map(
+            lambda m, v: lr * (m / c1.astype(m.dtype))
+            / (jnp.sqrt(v / c2.astype(v.dtype)) + conf.adam_eps),
+            vel, hist)
+    elif which == "rmsprop":
+        rho = conf.rmsprop_decay
+        hist = jax.tree_util.tree_map(
+            lambda h, g: rho * h + (1 - rho) * g * g, hist, grads)
+        step = jax.tree_util.tree_map(
+            lambda g, h: lr * g / (jnp.sqrt(h) + eps), grads, hist)
+    elif which == "nesterov":
+        mom = _momentum_at(conf, iteration)
+        vel = jax.tree_util.tree_map(
+            lambda v, g: mom.astype(g.dtype) * v + g, vel, grads)
+        # look-ahead step: lr * (g + mu * v_new)
+        step = jax.tree_util.tree_map(
+            lambda g, v: lr * (g + mom.astype(g.dtype) * v), grads, vel)
+    elif which in ("", "sgd", "adagrad"):
+        # legacy reference chain; "sgd"/"adagrad" force the flag either way
+        use_adagrad = (conf.use_adagrad if which == ""
+                       else which == "adagrad")
+        if use_adagrad:
+            new_hist = jax.tree_util.tree_map(lambda h, g: h + g * g, hist,
+                                              grads)
+            if conf.adagrad_reset_iterations > 0:
+                resetting = (iteration % conf.adagrad_reset_iterations) == 0
+                new_hist = jax.tree_util.tree_map(
+                    lambda h, g: jnp.where(resetting, g * g, h), new_hist,
+                    grads)
+            scaled = jax.tree_util.tree_map(
+                lambda g, h: lr * g / (jnp.sqrt(h) + eps), grads, new_hist)
+            hist = new_hist
+        else:
+            scaled = jax.tree_util.tree_map(lambda g: lr * g, grads)
 
-    mom = _momentum_at(conf, iteration)
-    vel = jax.tree_util.tree_map(
-        lambda v, s: mom.astype(s.dtype) * v + s, state.velocity, scaled)
-    step = vel
+        mom = _momentum_at(conf, iteration)
+        vel = jax.tree_util.tree_map(
+            lambda v, s: mom.astype(s.dtype) * v + s, vel, scaled)
+        step = vel
+    else:
+        raise ValueError(
+            f"unknown updater {which!r}: expected one of "
+            "'' | sgd | adagrad | nesterov | adam | rmsprop")
 
     if conf.gradient_clip_norm > 0.0:
         gn = jnp.sqrt(sum(jnp.sum(jnp.square(x.astype(jnp.float32)))

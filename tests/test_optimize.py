@@ -100,3 +100,64 @@ def test_custom_grad_objective_rbm_style():
         num_iterations=50)
     out, _ = optimize(Objective(gs, sc), {"x": jnp.zeros(3)}, conf, KEY)
     np.testing.assert_allclose(out["x"], _XSTAR, atol=3e-2)
+
+
+@pytest.mark.parametrize("upd", ["adam", "nesterov", "rmsprop"])
+def test_new_updaters_minimize_quadratic(upd):
+    """Parity-plus updaters (VERDICT r1 #5): each drives the quadratic
+    toward its minimum via the SGD solver path."""
+    conf = NeuralNetConfiguration(
+        optimization_algo=OptimizationAlgorithm.ITERATION_GRADIENT_DESCENT,
+        lr=0.1 if upd != "nesterov" else 0.01, num_iterations=400,
+        updater=upd, momentum=0.9, termination_conditions=())
+    params, scores = optimize(from_loss(_quad_loss),
+                              {"x": jnp.zeros(3)}, conf, KEY)
+    assert np.isfinite(np.asarray(scores)).all()
+    np.testing.assert_allclose(np.asarray(params["x"]), np.asarray(_XSTAR),
+                               atol=0.15)
+
+
+def test_adam_bias_correction_first_step():
+    """First Adam step ~= lr * sign(g) (bias-corrected), not lr*(1-b1)*g."""
+    conf = NeuralNetConfiguration(updater="adam", lr=0.1)
+    params = {"x": jnp.array([1.0, -2.0])}
+    grads = {"x": jnp.array([0.5, -0.5])}
+    step, state = adjust_gradient(conf, jnp.asarray(0), grads, params,
+                                  init_updater(params))
+    np.testing.assert_allclose(np.asarray(step["x"]),
+                               [0.1, -0.1], rtol=1e-3)
+
+
+def test_termination_conditions_pluggable():
+    """Empty termination tuple runs all iterations; eps stops early."""
+    conf_all = NeuralNetConfiguration(
+        optimization_algo=OptimizationAlgorithm.ITERATION_GRADIENT_DESCENT,
+        lr=0.001, num_iterations=50, use_adagrad=False, momentum=0.0,
+        termination_conditions=())
+    conf_eps = conf_all.replace(termination_conditions=("eps",),
+                                termination_eps=1e-2)
+    _, s_all = optimize(from_loss(_quad_loss), {"x": jnp.zeros(3)},
+                        conf_all, KEY)
+    _, s_eps = optimize(from_loss(_quad_loss), {"x": jnp.zeros(3)},
+                        conf_eps, KEY)
+    # eps run freezes its score trace once |delta| < 1e-2; the free run
+    # keeps strictly improving to the end
+    assert float(s_all[-1]) < float(s_eps[-1])
+    # conf round-trips the new fields through JSON
+    c2 = NeuralNetConfiguration.from_json(conf_eps.to_json())
+    assert c2.termination_conditions == ("eps",)
+    assert c2.updater == conf_eps.updater
+
+
+def test_step_function_variants_applied():
+    """negative_default inverts the step: the objective must not decrease."""
+    from deeplearning4j_tpu.optimize.solver import apply_step
+
+    conf = NeuralNetConfiguration(step_function="negative_default")
+    x = jnp.array([1.0, 1.0])
+    d = jnp.array([1.0, 0.0])
+    out = apply_step(conf, x, d, 0.5)
+    np.testing.assert_allclose(np.asarray(out), [0.5, 1.0])
+    conf_g = NeuralNetConfiguration(step_function="gradient")
+    np.testing.assert_allclose(
+        np.asarray(apply_step(conf_g, x, d, 0.5)), [2.0, 1.0])

@@ -235,3 +235,48 @@ def test_local_runner_poisoned_job_terminates():
     out = runner.run(range(6))
     assert 3 not in out and len(out) == 5
     assert runner.tracker.count("jobs_failed") >= 1
+
+
+def test_remainder_batch_pad_and_mask_consumes_all_samples():
+    """VERDICT r1 #9: a batch not divisible by dp must not drop samples —
+    the masked step on dp=8 must equal a full-batch step on one device."""
+    conf = _mlp_conf()
+    x, y = _toy_data(n=30)  # 30 % 8 = 6 -> old path dropped 6 samples
+    net1 = MultiLayerNetwork(conf, seed=7).init()
+    net2 = MultiLayerNetwork(conf, seed=7).init()
+    mesh8 = make_mesh({"dp": 8})
+    mesh1 = make_mesh({"dp": 1}, devices=jax.devices()[:1])
+    t8 = DataParallelTrainer(net1, mesh8, mode="sync")
+    t1 = DataParallelTrainer(net2, mesh1, mode="sync")
+    t8.fit([(x, y)])
+    t1.fit([(x, y)])
+    for p8, p1 in zip(jax.tree_util.tree_leaves(t8.state.params),
+                      jax.tree_util.tree_leaves(t1.state.params)):
+        np.testing.assert_allclose(np.asarray(p8), np.asarray(p1),
+                                   rtol=2e-4, atol=2e-5)
+
+
+def test_remainder_batch_smaller_than_mesh():
+    """Even a batch smaller than the dp axis (some shards all-pad) trains."""
+    conf = _mlp_conf()
+    x, y = _toy_data(n=6)  # 6 < dp=8
+    net = MultiLayerNetwork(conf, seed=3).init()
+    trainer = DataParallelTrainer(net, make_mesh({"dp": 8}), mode="sync")
+    before = jax.tree_util.tree_leaves(trainer.state.params)[0].copy()
+    s = trainer.fit([(x, y)])
+    after = jax.tree_util.tree_leaves(trainer.state.params)[0]
+    assert np.isfinite(s)
+    assert not np.allclose(np.asarray(before), np.asarray(after))
+
+
+def test_remainder_batch_averaging_mode():
+    """Masked averaging round: remainder batches update and stay finite."""
+    conf = _mlp_conf()
+    x, y = _toy_data(n=30)
+    net = MultiLayerNetwork(conf, seed=5).init()
+    trainer = DataParallelTrainer(net, make_mesh({"dp": 8}),
+                                  mode="averaging", local_steps=2)
+    s0 = trainer.fit([(x, y)])
+    for _ in range(10):
+        s = trainer.fit([(x, y)])
+    assert np.isfinite(s) and s < s0

@@ -45,7 +45,8 @@ def test_batchnorm_ema_refreshed_after_fit():
     x = np.random.RandomState(0).rand(32, 4).astype(np.float32) * 5 + 3
     y = np.eye(2, dtype=np.float32)[np.random.RandomState(1).randint(0, 2, 32)]
     net.fit(x, y)
-    ema_mean = np.asarray(net.params[0]["ema_mean"])
+    p = net.params[0]
+    ema_mean = np.asarray(p["ema_mean"]) / max(float(p["ema_w"]), 1e-8)
     assert np.all(np.abs(ema_mean - x.mean(0)) < 0.5)  # refreshed, not zeros
 
 
@@ -67,3 +68,111 @@ def test_seed_zero_distinct_from_default():
     w0 = np.asarray(MultiLayerNetwork(conf, seed=0).init().params[0]["W"])
     w123 = np.asarray(MultiLayerNetwork(conf, seed=123).init().params[0]["W"])
     assert not np.allclose(w0, w123)
+
+
+def test_word2vec_tiny_corpus_tail_padding():
+    """ADVICE r1: 0 < n_pairs < batch_size must not crash fit() — the pad
+    wraps cyclically (np.resize) instead of slicing past the end."""
+    from deeplearning4j_tpu.models.word2vec import Word2Vec
+
+    sents = [["alpha", "beta", "gamma", "delta"],
+             ["alpha", "gamma", "beta", "delta"]]
+    w2v = Word2Vec(vector_length=8, window=2, negative=2,
+                   min_word_frequency=1, batch_size=512, epochs=1, seed=0)
+    w2v.fit(sents)  # n_pairs << 512: must pad, not raise
+    assert np.isfinite(np.asarray(w2v.vector("alpha"))).all()
+
+
+def test_char_lstm_short_text_clear_error():
+    """ADVICE r1: text shorter than seq_len+1 raises a clear ValueError,
+    not an opaque reshape failure."""
+    import pytest
+
+    from deeplearning4j_tpu.models.char_lstm import CharLSTM
+
+    lm = CharLSTM(hidden=8, seq_len=32, iterations=1)
+    with pytest.raises(ValueError, match="too short"):
+        lm.fit("abc")
+
+
+def test_char_lstm_beam_width_clamped_to_vocab():
+    """ADVICE r1: beam_width > vocab must not desync beams vs hs/cs rows."""
+    from deeplearning4j_tpu.models.char_lstm import CharLSTM
+
+    lm = CharLSTM(hidden=8, seq_len=4, iterations=2, n_layers=1)
+    lm.fit("abab" * 8)  # vocab = {a, b} -> v=2
+    text, score = lm.beam_search("ab", n=6, beam_width=10)
+    assert len(text) == 6
+    assert np.isfinite(score)
+
+
+def test_hessian_free_score_trace_finite():
+    """ADVICE r1: rejected first HF proposal must not report +inf."""
+    from deeplearning4j_tpu.nn.conf import (Activation, LossFunction,
+                                            WeightInit)
+    from deeplearning4j_tpu.optimize.solver import optimize
+
+    conf = NeuralNetConfiguration(
+        layer_type=LayerType.DENSE, n_in=2, n_out=2,
+        optimization_algo=OptimizationAlgorithm.HESSIAN_FREE,
+        num_iterations=4, lr=0.5)
+
+    from deeplearning4j_tpu.optimize.solver import from_loss
+
+    objective = from_loss(lambda params, key: jnp.sum((params["w"] - 3.0) ** 2))
+    params0 = {"w": jnp.zeros((2, 2))}
+    _, scores = optimize(objective, params0, conf, jax.random.PRNGKey(0))
+    assert np.isfinite(np.asarray(scores)).all()
+
+
+def test_batchnorm_running_ema_not_dominated_by_last_batch():
+    """VERDICT r1 #6: inference stats are a true running EMA across fit
+    batches, not a recompute from whichever batch came last."""
+    confs = (
+        NeuralNetConfiguration(layer_type=LayerType.BATCH_NORM, n_in=4,
+                               n_out=4),
+        NeuralNetConfiguration(
+            layer_type=LayerType.OUTPUT, n_in=4, n_out=2, num_iterations=2,
+            optimization_algo=OptimizationAlgorithm.ITERATION_GRADIENT_DESCENT),
+    )
+    conf = MultiLayerConfiguration(confs=confs)
+    net = MultiLayerNetwork(conf).init()
+    rng = np.random.RandomState(0)
+    big = rng.rand(64, 4).astype(np.float32) + 3.0      # mean ~3.5
+    tiny = rng.rand(2, 4).astype(np.float32) + 30.0     # shifted outlier
+    y_big = np.eye(2, dtype=np.float32)[rng.randint(0, 2, 64)]
+    y_tiny = np.eye(2, dtype=np.float32)[rng.randint(0, 2, 2)]
+    batches = [(big, y_big)] * 10 + [(tiny, y_tiny)]
+    net.fit(batches)
+    p = net.params[0]
+    mean = np.asarray(p["ema_mean"]) / max(float(p["ema_w"]), 1e-8)
+    # old post-hoc refresh would sit at ~30.5; the running EMA stays near
+    # the dominant distribution (tiny batch contributes ~10%)
+    assert np.all(mean < 10.0), mean
+    assert np.all(mean > 3.0), mean
+
+
+def test_batchnorm_ema_updates_inside_dp_train_step():
+    """BN running stats advance inside the compiled dp step (global-batch
+    statistics via psum), including on masked remainder batches."""
+    from deeplearning4j_tpu.parallel import DataParallelTrainer, make_mesh
+
+    confs = (
+        NeuralNetConfiguration(layer_type=LayerType.BATCH_NORM, n_in=4,
+                               n_out=4),
+        NeuralNetConfiguration(
+            layer_type=LayerType.OUTPUT, n_in=4, n_out=2, num_iterations=1,
+            optimization_algo=OptimizationAlgorithm.ITERATION_GRADIENT_DESCENT),
+    )
+    conf = MultiLayerConfiguration(confs=confs)
+    rng = np.random.RandomState(0)
+    x = (rng.rand(30, 4).astype(np.float32) * 2 + 5)  # 30 % 8 != 0: masked
+    y = np.eye(2, dtype=np.float32)[rng.randint(0, 2, 30)]
+    net = MultiLayerNetwork(conf, seed=1).init()
+    trainer = DataParallelTrainer(net, make_mesh({"dp": 8}), mode="sync")
+    trainer.fit([(x, y)])
+    p = trainer.state.params[0]
+    ema_w = float(p["ema_w"])
+    assert ema_w > 0.0
+    mean = np.asarray(p["ema_mean"]) / ema_w
+    np.testing.assert_allclose(mean, x.mean(0), rtol=0.05, atol=0.1)

@@ -132,7 +132,7 @@ def test_char_transformer_lm_learns():
     y = jax.nn.one_hot(jnp.asarray(seqs[:, 1:]).reshape(-1), vocab)
 
     conf = char_transformer(vocab, d_model=32, n_blocks=1, n_heads=4,
-                            max_seq_len=seq, lr=0.05, iterations=150)
+                            max_seq_len=seq, lr=0.01, iterations=150)
     net = MultiLayerNetwork(conf, seed=0).init()
     net.fit(x, y)
     out = np.asarray(net.output(x)).reshape(batch, seq, vocab)
