@@ -125,6 +125,11 @@ def read_word_vectors(path: str) -> InMemoryLookupTable:
             parts = line.rstrip("\n").split(" ")
             words.append(parts[0])
             vecs.append([float(x) for x in parts[1:d + 1]])
+    return _table_from(words, np.asarray(vecs, np.float32), d)
+
+
+def _table_from(words: List[str], vecs: np.ndarray,
+                d: int) -> InMemoryLookupTable:
     cache = VocabCache()
     cache.fit([words])  # one occurrence each; preserves all words
     table = InMemoryLookupTable(cache, d)
@@ -133,3 +138,42 @@ def read_word_vectors(path: str) -> InMemoryLookupTable:
         syn0[cache.index_of(w)] = v
     table.syn0 = jnp.asarray(syn0)
     return table
+
+
+def write_word_vectors_binary(table: InMemoryLookupTable, path: str) -> None:
+    """word2vec C *binary* format (the `loadGoogleModel(binary=true)` format
+    of the reference's `WordVectorSerializer.java`): ASCII header
+    "V D\\n", then per word: "word" + 0x20 + D little-endian f32s + "\\n"."""
+    syn0 = np.asarray(table.syn0, np.float32)
+    with open(path, "wb") as f:
+        f.write(f"{syn0.shape[0]} {syn0.shape[1]}\n".encode("utf-8"))
+        for i, w in enumerate(table.cache.words()):
+            f.write(w.encode("utf-8") + b" ")
+            f.write(syn0[i].astype("<f4").tobytes())
+            f.write(b"\n")
+
+
+def read_word_vectors_binary(path: str) -> InMemoryLookupTable:
+    """Read the word2vec C binary format (google-news model layout).
+
+    Tolerates both the canonical trailing "\\n" per row and the
+    space-separated variant some writers emit."""
+    with open(path, "rb") as f:
+        header = f.readline().split()
+        n, d = int(header[0]), int(header[1])
+        row_bytes = d * 4
+        words, vecs = [], []
+        for _ in range(n):
+            # word = bytes up to the first 0x20 (skipping leading newlines)
+            chars = []
+            while True:
+                c = f.read(1)
+                if not c:
+                    raise ValueError("truncated word2vec binary file")
+                if c == b" ":
+                    break
+                if c != b"\n":
+                    chars.append(c)
+            words.append(b"".join(chars).decode("utf-8"))
+            vecs.append(np.frombuffer(f.read(row_bytes), dtype="<f4"))
+    return _table_from(words, np.asarray(vecs, np.float32), d)

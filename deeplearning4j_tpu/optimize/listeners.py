@@ -31,6 +31,51 @@ class ScoreIterationListener(IterationListener):
             log.info("Score at iteration %d is %s", iteration, score)
 
 
+class CheckpointListener(IterationListener):
+    """Persist the current model every N iterations (`ModelSavingActor`
+    parity — it saved `stateTracker.getCurrent()` on every MoreWorkMessage;
+    plus optimizer state + step, which the reference never checkpointed).
+
+    Works with anything dispatch() hands it: a `DataParallelTrainer`
+    (saves state.params + updater + step) or a `MultiLayerNetwork`
+    (saves params + conf).  Writes are async by default, like the actor.
+    """
+
+    def __init__(self, directory: str, save_every_n: int = 10,
+                 asynchronous: bool = True):
+        self.directory = directory
+        self.save_every_n = max(1, save_every_n)
+        self.asynchronous = asynchronous
+        self.saves = 0
+        self._last_thread = None
+
+    def iteration_done(self, model, iteration, score):
+        if iteration % self.save_every_n != 0:
+            return
+        from deeplearning4j_tpu.parallel import checkpoint
+
+        state = getattr(model, "state", None)
+        net = getattr(model, "net", model)
+        conf = getattr(net, "conf", None)
+        meta = {"score": float(score)}
+        if state is not None:
+            args = (self.directory, state.params, state.updater)
+            kw = dict(conf=conf, step=int(state.step), metadata=meta)
+        else:
+            args = (self.directory, net.params, None)
+            kw = dict(conf=conf, step=int(iteration), metadata=meta)
+        if self.asynchronous:
+            self._last_thread = checkpoint.save_async(*args, **kw)
+        else:
+            checkpoint.save(*args, **kw)
+        self.saves += 1
+
+    def wait(self) -> None:
+        """Block until the last async save has landed."""
+        if self._last_thread is not None:
+            self._last_thread.join()
+
+
 class ComposableIterationListener(IterationListener):
     def __init__(self, listeners: Sequence[IterationListener]):
         self.listeners = list(listeners)

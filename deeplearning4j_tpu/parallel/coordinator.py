@@ -42,13 +42,57 @@ class Job:
 MAX_JOB_ATTEMPTS = 3  # JobFailed requeue cap (poisoned jobs must not spin)
 
 
+class LocalFileUpdateSaver:
+    """Spill worker updates to disk between rounds so aggregation survives
+    a master restart (`LocalFileUpdateSaver.java:38-143` parity).  One
+    atomically-published pickle per update, FIFO-ordered via
+    `utils/disk_queue.DiskBasedQueue`."""
+
+    def __init__(self, directory: str):
+        from deeplearning4j_tpu.utils.disk_queue import DiskBasedQueue
+
+        self.directory = directory
+        self._queue = DiskBasedQueue(directory)
+        # a fresh master over an old spill dir inherits the banked updates
+        import os
+
+        existing = sorted(
+            f for f in os.listdir(directory) if f.endswith(".pkl"))
+        self._queue._order.extend(
+            os.path.join(directory, f) for f in existing)
+        if existing:
+            self._queue._counter = (
+                int(os.path.splitext(existing[-1])[0]) + 1)
+
+    def save(self, worker_id: str, update: Any) -> None:
+        self._queue.add((worker_id, update))
+
+    def drain(self) -> List[Tuple[str, Any]]:
+        """Remove and return every spilled (worker_id, update)."""
+        out = []
+        while True:
+            item = self._queue.poll()
+            if item is None:
+                return out
+            out.append(item)
+
+    def __len__(self) -> int:
+        return len(self._queue)
+
+
 class StateTracker:
     """Cluster state: workers, heartbeats, job slots, updates, current
     model, named counters.  Thread-safe; distributed deployments wrap it in
     the REST server below (workers poll over HTTP the way WorkerActor
-    polled Hazelcast job slots)."""
+    polled Hazelcast job slots).
 
-    def __init__(self, stale_after_s: float = DEFAULT_STALE_AFTER_S):
+    `update_dir` enables intra-round durability: every `add_update` also
+    spills to disk, and a tracker (re)created over the same directory
+    recovers the banked updates — a master restart mid-round loses nothing
+    (`LocalFileUpdateSaver.java` parity)."""
+
+    def __init__(self, stale_after_s: float = DEFAULT_STALE_AFTER_S,
+                 update_dir: Optional[str] = None):
         self._lock = threading.RLock()
         self._workers: Dict[str, float] = {}        # id -> last heartbeat
         self._enabled: Dict[str, bool] = {}
@@ -60,6 +104,16 @@ class StateTracker:
         self._batches_so_far = 0
         self._minibatch_size = 0
         self.stale_after_s = stale_after_s
+        self._saver: Optional[LocalFileUpdateSaver] = None
+        if update_dir is not None:
+            import os
+
+            os.makedirs(update_dir, exist_ok=True)
+            self._saver = LocalFileUpdateSaver(update_dir)
+            # recover updates a crashed master had already banked
+            self._updates.extend(self._saver.drain())
+            for worker_id, update in self._updates:
+                self._saver.save(worker_id, update)
 
     # -- membership / heartbeats (StateTracker.java:326-332) ---------------
     def add_worker(self, worker_id: str) -> None:
@@ -132,6 +186,8 @@ class StateTracker:
             # an append log, not a worker-keyed map: one worker may finish
             # several jobs per wave and every result must survive
             self._updates.append((worker_id, result))
+            if self._saver is not None:  # intra-round durability
+                self._saver.save(worker_id, result)
             job = self._jobs.get(worker_id)
             if job is not None:
                 job.pending = False
@@ -145,6 +201,8 @@ class StateTracker:
     def clear_updates(self) -> None:
         with self._lock:
             self._updates.clear()
+            if self._saver is not None:
+                self._saver.drain()  # the round aggregated; drop the spill
 
     # -- current model (StateTracker.java:90-97) ---------------------------
     def set_current(self, model) -> None:

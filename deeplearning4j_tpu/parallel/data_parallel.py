@@ -338,6 +338,20 @@ class DataParallelTrainer:
         self._key, sub = jax.random.split(self._key)
         return sub
 
+    def restore(self, directory: str) -> int:
+        """Resume from a `CheckpointListener` checkpoint: params, updater
+        state, and step counter land back in TrainState (kill-and-resume).
+        Returns the restored step."""
+        from deeplearning4j_tpu.parallel import checkpoint
+
+        params, updater, meta = checkpoint.load(
+            directory, like_params=self.state.params,
+            like_updater=self.state.updater)
+        self.state = TrainState(params=params, updater=updater,
+                                step=jnp.asarray(meta["step"], jnp.int32))
+        self.net.params = params
+        return int(meta["step"])
+
     def _step_padded(self, x, y):
         """Zero-pad a remainder batch to a dp-divisible shape and run the
         masked step (pad rows carry weight 0).  Label rows may be a multiple

@@ -22,3 +22,8 @@ import jax  # noqa: E402  (import after env is set)
 # re-assert the host platform explicitly
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", False)
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: multi-process integration tests (tens of seconds)")

@@ -280,3 +280,63 @@ def test_remainder_batch_averaging_mode():
     for _ in range(10):
         s = trainer.fit([(x, y)])
     assert np.isfinite(s) and s < s0
+
+
+def test_checkpoint_listener_kill_and_resume(tmp_path):
+    """VERDICT r1 #7: a CheckpointListener persists params+updater+step
+    every N iterations from the training loop; a fresh trainer restores
+    them and continues exactly where the dead one stopped."""
+    from deeplearning4j_tpu.optimize.listeners import CheckpointListener
+
+    conf = _mlp_conf()
+    x, y = _toy_data(n=32)
+    ckpt_dir = str(tmp_path / "auto_ckpt")
+    mesh = make_mesh({"dp": 8})
+
+    listener = CheckpointListener(ckpt_dir, save_every_n=1,
+                                  asynchronous=False)
+    t1 = DataParallelTrainer(MultiLayerNetwork(conf, seed=11).init(), mesh,
+                             mode="sync", listeners=[listener])
+    for _ in range(5):
+        t1.fit([(x, y)])
+    assert listener.saves >= 5  # invoked periodically from the loop
+
+    # "kill": new process stands in as a brand-new trainer + restore
+    t2 = DataParallelTrainer(MultiLayerNetwork(conf, seed=99).init(), mesh,
+                             mode="sync")
+    step = t2.restore(ckpt_dir)
+    assert step == int(t1.state.step)
+    for a, b in zip(jax.tree_util.tree_leaves(t1.state.params),
+                    jax.tree_util.tree_leaves(t2.state.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    for a, b in zip(jax.tree_util.tree_leaves(t1.state.updater),
+                    jax.tree_util.tree_leaves(t2.state.updater)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    # resumed trainer keeps training from the restored state
+    s = t2.fit([(x, y)])
+    assert np.isfinite(s)
+    assert int(t2.state.step) == step + 1
+
+
+def test_state_tracker_update_spill_survives_restart(tmp_path):
+    """VERDICT r1 #5: updates spill through the disk queue, so a master
+    restart mid-round recovers every banked update."""
+    spill = str(tmp_path / "updates")
+    t1 = StateTracker(update_dir=spill)
+    t1.add_worker("w0")
+    t1.add_worker("w1")
+    t1.add_update("w0", np.arange(4.0))
+    t1.add_update("w1", np.arange(4.0) * 2)
+    del t1  # master dies mid-round, aggregation not yet run
+
+    t2 = StateTracker(update_dir=spill)  # restart over the same spill dir
+    ups = t2.updates()
+    assert len(ups) == 2
+    np.testing.assert_array_equal(ups[0], np.arange(4.0))
+    np.testing.assert_array_equal(ups[1], np.arange(4.0) * 2)
+    # aggregation clears both memory and the spill
+    t2.clear_updates()
+    assert t2.updates() == []
+    t3 = StateTracker(update_dir=spill)
+    assert t3.updates() == []
