@@ -200,8 +200,10 @@ def bench_word2vec(devs) -> None:
     from deeplearning4j_tpu.models.word2vec import Word2Vec
 
     rng = np.random.RandomState(0)
+    # realistic scale: word2vec corpora are millions of tokens, so the
+    # one-time XLA compile amortizes the way word2vec.c's startup does
     vocab_n, n_tokens, sent_len, epochs = ((200, 4000, 20, 1) if SMALL else
-                                           (2000, 120_000, 20, 3))
+                                           (10_000, 1_200_000, 20, 3))
     # zipf-ish unigram draw: realistic subsampling + negative table shape
     freq = 1.0 / np.arange(1, vocab_n + 1)
     probs = freq / freq.sum()
@@ -211,7 +213,8 @@ def bench_word2vec(devs) -> None:
              for i in range(0, n_tokens, sent_len)]
 
     w2v = Word2Vec(vector_length=128, window=5, negative=5,
-                   min_word_frequency=1, epochs=epochs, seed=0)
+                   min_word_frequency=1, epochs=epochs, seed=0,
+                   batch_size=64 if SMALL else 32_768)
     t0 = time.perf_counter()
     w2v.fit(sents)
     _host_sync(w2v.table.syn0)

@@ -58,6 +58,20 @@ class InMemoryLookupTable:
         counts = self.cache.counts() ** power
         return (counts / counts.sum()).astype(np.float32)
 
+    def unigram_table(self, size: int = 1 << 20,
+                      power: float = 0.75) -> np.ndarray:
+        """word2vec.c-style negative-sampling table (ref
+        InMemoryLookupTable.java:108-130 `makeTable`): word i occupies a
+        slot span proportional to count^0.75. Sampling a negative is then
+        one uniform int + one gather — three orders of magnitude cheaper
+        on device than a categorical over the vocab (which materializes
+        [B, K, V] Gumbel noise per step)."""
+        probs = self.unigram_table_probs(power).astype(np.float64)
+        cum = np.cumsum(probs)
+        cum[-1] = 1.0  # guard fp drift so searchsorted never returns V
+        return np.searchsorted(
+            cum, (np.arange(size) + 0.5) / size).astype(np.int32)
+
     # -- WordVectors query surface ----------------------------------------
     def vector(self, word: str) -> Optional[np.ndarray]:
         i = self.cache.index_of(word)

@@ -55,8 +55,7 @@ class ParagraphVectors(Word2Vec):
         codes_all, points_all, mask_all = Huffman.padded_arrays(self.cache)
         if not self.use_hs:
             mask_all = np.zeros_like(mask_all)
-        neg_logits = jnp.log(jnp.asarray(
-            self.table.unigram_table_probs()) + 1e-30)
+        neg_table = jnp.asarray(self.table.unigram_table())
 
         doc_ids, word_ids = [], []
         for d, toks in enumerate(token_lists):
@@ -79,6 +78,10 @@ class ParagraphVectors(Word2Vec):
                   if self.table.syn1neg is not None else
                   jnp.zeros((self.cache.num_words(), self.vector_length),
                             jnp.float32)}
+        if self.use_adagrad:
+            # doc phase honors the same per-word AdaGrad as the word phase
+            for k in ("syn0", "syn1", "syn1neg"):
+                tables["h_" + k] = jnp.zeros_like(tables[k])
         B = min(self.batch_size, len(doc_ids))
         rng = np.random.RandomState(self.seed)
         steps_total = max(1, self.doc_epochs * ((len(doc_ids) - 1) // B + 1))
@@ -98,8 +101,8 @@ class ParagraphVectors(Word2Vec):
                     jnp.asarray(codes_all[w_np]),
                     jnp.asarray(points_all[w_np]),
                     jnp.asarray(mask_all[w_np]),
-                    neg_logits, sub, jnp.asarray(alpha, jnp.float32),
-                    self.negative)
+                    neg_table, sub, jnp.asarray(alpha, jnp.float32),
+                    self.negative, self.use_adagrad)
                 step_i += 1
         self.doc_vectors = tables["syn0"]
         return self

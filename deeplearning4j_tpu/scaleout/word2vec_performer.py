@@ -65,8 +65,7 @@ class DistributedWord2Vec(Word2Vec):
         codes_all, points_all, mask_all = Huffman.padded_arrays(self.cache)
         if not self.use_hs:
             mask_all = np.zeros_like(mask_all)
-        neg_logits = jnp.log(jnp.asarray(
-            self.table.unigram_table_probs()) + 1e-30)
+        neg_table = jnp.asarray(self.table.unigram_table())
         n_rows = self.cache.num_words()
         syn1neg0 = (self.table.syn1neg if self.table.syn1neg is not None
                     else np.zeros((n_rows, self.vector_length), np.float32))
@@ -75,6 +74,12 @@ class DistributedWord2Vec(Word2Vec):
         tables = {"syn0": np.array(self.table.syn0, np.float32),
                   "syn1": np.array(self.table.syn1, np.float32),
                   "syn1neg": np.array(syn1neg0, np.float32)}
+        if self.use_adagrad:
+            # per-word AdaGrad history rides the same delta machinery:
+            # h increments are sums of g^2, so summing worker deltas is
+            # exactly the distributed-AdaGrad accumulator merge
+            for k in ("syn0", "syn1", "syn1neg"):
+                tables["h_" + k] = np.zeros_like(tables[k])
 
         # chunk the pair stream into jobs (Word2VecJobIterator role)
         n_jobs = self.jobs_per_round or self.n_workers
@@ -120,8 +125,9 @@ class DistributedWord2Vec(Word2Vec):
                 cur, _ = _w2v_step(
                     cur, jnp.asarray(cb), jnp.asarray(tb),
                     jnp.asarray(codes_all[tb]), jnp.asarray(points_all[tb]),
-                    jnp.asarray(mask_all[tb]), neg_logits, sub,
-                    jnp.asarray(alpha, jnp.float32), self.negative)
+                    jnp.asarray(mask_all[tb]), neg_table, sub,
+                    jnp.asarray(alpha, jnp.float32), self.negative,
+                    self.use_adagrad)
             touched = np.unique(np.concatenate([c_np, t_np]))
             deltas = {
                 "syn0": _row_deltas(np.asarray(cur["syn0"]),
@@ -134,6 +140,13 @@ class DistributedWord2Vec(Word2Vec):
                                        start["syn1neg"],
                                        np.arange(len(start["syn1neg"]))),
             }
+            if self.use_adagrad:
+                deltas["h_syn0"] = _row_deltas(
+                    np.asarray(cur["h_syn0"]), start["h_syn0"], touched)
+                for name in ("h_syn1", "h_syn1neg"):
+                    deltas[name] = _row_deltas(
+                        np.asarray(cur[name]), start[name],
+                        np.arange(len(start[name])))
             if self.hogwild:  # apply eagerly, return nothing to aggregate
                 with apply_lock:
                     _apply(deltas)

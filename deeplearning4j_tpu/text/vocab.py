@@ -50,10 +50,14 @@ class VocabCache:
         """Count tokens, drop words under min_word_frequency, assign indices
         by descending frequency (the order Huffman + the unigram table
         expect)."""
+        from collections import Counter
+
+        counts = Counter()
         for tokens in sentences_tokens:
             self.n_docs += 1
-            for t in tokens:
-                self.increment_word_count(t)
+            counts.update(tokens)  # C-speed counting, no per-token Python
+        for w, c in counts.items():
+            self.increment_word_count(w, c)
         self.vocab = {w: vw for w, vw in self.vocab.items()
                       if vw.count >= self.min_word_frequency}
         self._index = sorted(self.vocab,

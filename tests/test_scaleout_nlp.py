@@ -48,6 +48,18 @@ class TestDistributedWord2Vec:
         v = w2v.vector("king")
         assert v.shape == (24,) and np.all(np.isfinite(np.asarray(v)))
 
+    def test_distributed_adagrad_merges_history_and_converges(self):
+        """use_adagrad must reach the distributed path too (r3 review):
+        worker h-deltas (sums of g^2) merge additively into shared
+        accumulators, and quality still holds."""
+        w2v = DistributedWord2Vec(
+            CORPUS, vector_length=24, window=4, min_word_frequency=2,
+            negative=3, epochs=6, batch_size=256, seed=7,
+            n_workers=3, use_adagrad=True)
+        w2v.fit()
+        assert w2v.similarity("king", "queen") > w2v.similarity(
+            "king", "mouse")
+
     def test_tracker_saw_jobs(self):
         from deeplearning4j_tpu.parallel.coordinator import StateTracker
         tr = StateTracker()
