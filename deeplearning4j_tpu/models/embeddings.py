@@ -53,8 +53,9 @@ class InMemoryLookupTable:
 
     def unigram_table_probs(self, power: float = 0.75) -> np.ndarray:
         """Noise distribution counts^0.75 (the reference's `table` array,
-        :108-130, as probabilities — sampling happens on device via
-        jax.random.categorical over the log of these)."""
+        :108-130, as probabilities). Sampling uses `unigram_table` below —
+        these probabilities are its input and are exposed for tests/GloVe
+        weighting."""
         counts = self.cache.counts() ** power
         return (counts / counts.sum()).astype(np.float32)
 

@@ -17,7 +17,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from deeplearning4j_tpu.models.word2vec import Word2Vec, _w2v_step
+from deeplearning4j_tpu.models.word2vec import (Word2Vec, _w2v_step,
+                                                 add_adagrad_state)
 from deeplearning4j_tpu.text.vocab import Huffman
 
 
@@ -80,8 +81,7 @@ class ParagraphVectors(Word2Vec):
                             jnp.float32)}
         if self.use_adagrad:
             # doc phase honors the same per-word AdaGrad as the word phase
-            for k in ("syn0", "syn1", "syn1neg"):
-                tables["h_" + k] = jnp.zeros_like(tables[k])
+            add_adagrad_state(tables)
         B = min(self.batch_size, len(doc_ids))
         rng = np.random.RandomState(self.seed)
         steps_total = max(1, self.doc_epochs * ((len(doc_ids) - 1) // B + 1))

@@ -39,6 +39,15 @@ from deeplearning4j_tpu.text.vocab import Huffman, VocabCache
 log = logging.getLogger("deeplearning4j_tpu")
 
 
+def add_adagrad_state(tables: dict) -> dict:
+    """Attach zeroed per-word AdaGrad accumulators ``h_*`` for each lookup
+    table, in the table's own array flavor (numpy stays numpy, jax stays
+    jax) — shared by Word2Vec, ParagraphVectors, and DistributedWord2Vec."""
+    for k in ("syn0", "syn1", "syn1neg"):
+        tables["h_" + k] = tables[k] * 0
+    return tables
+
+
 def _w2v_step_impl(tables, centers, contexts, codes, points, code_mask,
                    neg_table, key, alpha, negative: int,
                    use_adagrad: bool = False):
@@ -236,8 +245,7 @@ class Word2Vec:
                                         self.vector_length), jnp.float32)),
         }
         if self.use_adagrad:
-            for k in ("syn0", "syn1", "syn1neg"):
-                tables["h_" + k] = jnp.zeros_like(tables[k])
+            add_adagrad_state(tables)
         key = jax.random.PRNGKey(self.seed)
 
         centers, contexts = self._pairs(ids_per_sentence)

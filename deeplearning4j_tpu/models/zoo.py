@@ -123,7 +123,7 @@ def vgg_cifar10(lr: float = 0.05, iterations: int = 1,
 
 def char_transformer(vocab: int, d_model: int = 128, n_blocks: int = 2,
                      n_heads: int = 4, max_seq_len: int = 256,
-                     lr: float = 0.1, iterations: int = 1,
+                     lr: float = 1e-3, iterations: int = 1,
                      updater: str = "adam") -> MultiLayerConfiguration:
     """Decoder-only char transformer LM (new scope — the reference's only
     sequence model is the scalar-loop LSTM).  Embedding (+ learned

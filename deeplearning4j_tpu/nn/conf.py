@@ -186,6 +186,9 @@ class NeuralNetConfiguration:
     compute_dtype: str = ""         # matmul/conv operand dtype ("" = dtype);
                                     # "bfloat16" = mixed precision: bf16 MXU
                                     # inputs, f32 accumulation, f32 params
+    remat: bool = False             # jax.checkpoint this layer's forward:
+                                    # recompute activations in backward,
+                                    # trading FLOPs for HBM (big batches)
 
     def replace(self, **kwargs) -> "NeuralNetConfiguration":
         return dataclasses.replace(self, **kwargs)

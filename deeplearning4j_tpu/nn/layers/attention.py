@@ -16,6 +16,7 @@ import jax.numpy as jnp
 
 from deeplearning4j_tpu.nd import random as ndr
 from deeplearning4j_tpu.nn.weights import init_weights
+from deeplearning4j_tpu.nn.layers.base import compute_dtype, mixed_matmul
 from deeplearning4j_tpu.nd.attention import (blockwise_attention,
                                              full_attention)
 
@@ -53,9 +54,13 @@ class MultiHeadAttentionLayer:
         b, s, n = x.shape
         h = conf.n_heads
         hd = n // h
+        cd = compute_dtype(conf)
         xn = _layer_norm(x, params["ln_g"], params["ln_b"])
-        qkv = xn @ params["Wqkv"] + params["bqkv"]
-        q, k, v = jnp.split(qkv, 3, axis=-1)
+        # projections AND the S^2 score/value matmuls run in compute_dtype
+        # (bf16 feeds the MXU at full rate; f32 runs at half peak) — the
+        # residual stream and layer norm stay in the param dtype
+        qkv = mixed_matmul(xn, params["Wqkv"], conf) + params["bqkv"]
+        q, k, v = jnp.split(qkv.astype(cd), 3, axis=-1)
         q = q.reshape(b, s, h, hd)
         k = k.reshape(b, s, h, hd)
         v = v.reshape(b, s, h, hd)
@@ -63,7 +68,19 @@ class MultiHeadAttentionLayer:
         impl = conf.attention_impl
         if impl == "auto":
             if jax.devices()[0].platform == "tpu":
-                impl = "flash"
+                # measured on v5e: XLA's dense attention (heads batched into
+                # big MXU matmuls) beats the Pallas flash kernel up through
+                # S=2048 (224 vs 432 ms/step at S=2048); beyond that the
+                # [S,S] scores no longer fit HBM and flash is the only
+                # option. The 8 GiB bound is the measured per-layer failure
+                # boundary (S=2048/B=16/H=16 = 4.3 GiB trains, S=4096/B=8 =
+                # 8.6 GiB OOMs); it is per-LAYER because XLA rematerializes
+                # probs inside fusions rather than retaining one [B,H,S,S]
+                # per block (8 blocks x 2 GiB at S=1024 runs fine), and b
+                # here is the per-device batch under shard_map. Overrides:
+                # conf.attention_impl pins an impl, conf.remat frees HBM.
+                scores_bytes = 4 * b * h * s * s  # f32 fwd scores
+                impl = "full" if scores_bytes <= (8 << 30) else "flash"
             else:
                 impl = "blockwise" if blk else "full"
         if impl == "flash":
@@ -74,7 +91,8 @@ class MultiHeadAttentionLayer:
                                     causal=conf.causal)
         else:
             o = full_attention(q, k, v, causal=conf.causal)
-        o = o.reshape(b, s, n) @ params["Wo"] + params["bo"]
+        o = mixed_matmul(o.reshape(b, s, n).astype(x.dtype),
+                         params["Wo"], conf) + params["bo"]
         if training and conf.dropout > 0.0 and key is not None:
             o = o * ndr.dropout_mask(key, 1.0 - conf.dropout, o.shape, o.dtype)
         return x + o
@@ -117,8 +135,8 @@ class TransformerFFNLayer:
     @staticmethod
     def forward(params, conf, x, key=None, training=False):
         xn = _layer_norm(x, params["ln_g"], params["ln_b"])
-        h = jax.nn.gelu(xn @ params["W1"] + params["b1"])
-        o = h @ params["W2"] + params["b2"]
+        h = jax.nn.gelu(mixed_matmul(xn, params["W1"], conf) + params["b1"])
+        o = mixed_matmul(h, params["W2"], conf) + params["b2"]
         if training and conf.dropout > 0.0 and key is not None:
             o = o * ndr.dropout_mask(key, 1.0 - conf.dropout, o.shape, o.dtype)
         return x + o

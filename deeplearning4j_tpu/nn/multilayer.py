@@ -50,6 +50,18 @@ def init_params(conf: MultiLayerConfiguration, key) -> tuple:
     )
 
 
+def _layer_forward(impl, c, params, h, key, training):
+    """One layer's forward, optionally under jax.checkpoint (conf.remat):
+    activations inside the layer are recomputed during backward instead of
+    stored, trading ~1/3 extra FLOPs for HBM capacity — the standard TPU
+    trick for fitting larger batches (SURVEY §7 / scaling-book recipe)."""
+    if c.remat and training:
+        return jax.checkpoint(
+            lambda p, hh, kk: impl.forward(p, c, hh, kk, training)
+        )(params, h, key)
+    return impl.forward(params, c, h, key, training)
+
+
 def feed_forward(conf: MultiLayerConfiguration, params, x, key=None,
                  training=False, up_to: Optional[int] = None):
     """Activations after each layer (MultiLayerNetwork.feedForward parity).
@@ -64,7 +76,8 @@ def feed_forward(conf: MultiLayerConfiguration, params, x, key=None,
     for i in range(n):
         c = conf.conf(i)
         x = apply_preprocessor(conf.preprocessor(i), x)
-        x = get_layer(c.layer_type).forward(params[i], c, x, keys[i], training)
+        x = _layer_forward(get_layer(c.layer_type), c, params[i], x,
+                           keys[i], training)
         acts.append(x)
     return acts
 
@@ -85,7 +98,8 @@ def network_loss(conf: MultiLayerConfiguration, params, x, labels, key=None,
     for i in range(n - 1):
         c = conf.conf(i)
         h = apply_preprocessor(conf.preprocessor(i), h)
-        h = get_layer(c.layer_type).forward(params[i], c, h, keys[i], training)
+        h = _layer_forward(get_layer(c.layer_type), c, params[i], h,
+                           keys[i], training)
     out_conf = conf.conf(n - 1)
     h = apply_preprocessor(conf.preprocessor(n - 1), h)
     loss = OutputLayer.loss(params[n - 1], out_conf, h, labels, keys[n - 1],
@@ -136,7 +150,7 @@ def network_rowwise_loss(conf: MultiLayerConfiguration, params, x, labels,
                                            mean.astype(h.dtype),
                                            var.astype(h.dtype))
         else:
-            h = impl.forward(params[i], c, h, keys[i], training)
+            h = _layer_forward(impl, c, params[i], h, keys[i], training)
     out_conf = conf.conf(n - 1)
     h = apply_preprocessor(conf.preprocessor(n - 1), h)
     rows = OutputLayer.rowwise_loss(params[n - 1], out_conf, h, labels,

@@ -18,7 +18,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from deeplearning4j_tpu.models.word2vec import Word2Vec, _w2v_step
+from deeplearning4j_tpu.models.word2vec import (Word2Vec, _w2v_step,
+                                                 add_adagrad_state)
 from deeplearning4j_tpu.parallel.coordinator import LocalRunner, StateTracker
 from deeplearning4j_tpu.text.vocab import Huffman
 
@@ -78,8 +79,7 @@ class DistributedWord2Vec(Word2Vec):
             # per-word AdaGrad history rides the same delta machinery:
             # h increments are sums of g^2, so summing worker deltas is
             # exactly the distributed-AdaGrad accumulator merge
-            for k in ("syn0", "syn1", "syn1neg"):
-                tables["h_" + k] = np.zeros_like(tables[k])
+            add_adagrad_state(tables)
 
         # chunk the pair stream into jobs (Word2VecJobIterator role)
         n_jobs = self.jobs_per_round or self.n_workers

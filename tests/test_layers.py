@@ -173,3 +173,37 @@ def test_mixed_precision_compute_dtype():
     assert y16.dtype == jnp.float32  # cast back to the param dtype
     np.testing.assert_allclose(np.asarray(y16), np.asarray(y32),
                                rtol=2e-2, atol=2e-2)
+
+
+def test_remat_matches_no_remat_loss_and_grads():
+    """conf.remat wraps a layer in jax.checkpoint — backward recomputes
+    activations but loss and gradients must be bitwise-identical to the
+    stored-activation path."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from deeplearning4j_tpu.models.zoo import char_transformer
+    from deeplearning4j_tpu.nn.multilayer import (init_params,
+                                                  network_rowwise_loss)
+
+    conf = char_transformer(17, d_model=32, n_blocks=2, n_heads=4,
+                            max_seq_len=8)
+    conf_r = conf.replace(confs=tuple(c.replace(remat=True)
+                                      for c in conf.confs))
+    params = init_params(conf, jax.random.PRNGKey(0))
+    x = jnp.asarray(np.random.RandomState(0).randint(0, 17, (3, 8)),
+                    jnp.int32)
+    y = jnp.asarray(np.eye(17, dtype=np.float32)[
+        np.random.RandomState(1).randint(0, 17, 24)])
+
+    def loss(c):
+        return lambda p: jnp.mean(network_rowwise_loss(c, p, x, y,
+                                                       training=True))
+
+    l0, g0 = jax.value_and_grad(loss(conf))(params)
+    l1, g1 = jax.value_and_grad(loss(conf_r))(params)
+    assert float(l0) == float(l1)
+    for a, b in zip(jax.tree_util.tree_leaves(g0),
+                    jax.tree_util.tree_leaves(g1)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
