@@ -14,10 +14,13 @@ identical shapes/classes is generated so tests and benchmarks are hermetic.
 from __future__ import annotations
 
 import csv as csv_mod
+import logging
 import os
 from typing import Optional
 
 import numpy as np
+
+log = logging.getLogger("deeplearning4j_tpu")
 
 from deeplearning4j_tpu.datasets import mnist as mnist_mod
 from deeplearning4j_tpu.datasets.dataset import DataSet, labels_to_one_hot
@@ -53,6 +56,15 @@ class MnistDataFetcher(BaseDataFetcher):
 
     def fetch(self, num_examples: int = 60000) -> DataSet:
         d = mnist_mod.find_mnist_dir()
+        if d is None and os.environ.get("DL4J_MNIST_URL"):
+            # no local copy but a source is configured: download + verify
+            # (MnistFetcher.java downloadAndUntar parity; datasets/fetch.py)
+            from deeplearning4j_tpu.datasets.fetch import fetch_mnist
+
+            try:
+                d = fetch_mnist()
+            except IOError as e:
+                log.warning("MNIST download failed (%r); using synthetic", e)
         if d is not None:
             X, y = mnist_mod.load_real_mnist(d, self.train)
             X, y = X[:num_examples], y[:num_examples]
@@ -70,6 +82,22 @@ class LFWDataFetcher(BaseDataFetcher):
         self.n_classes = n_classes
 
     def fetch(self, num_examples: int = 1000) -> DataSet:
+        # preferred real path (LFWLoader.java parity): a downloaded (or
+        # pre-existing) person-per-directory image tree read through
+        # ImageRecordReader; falls back to the sklearn cache, then synthetic
+        root = os.environ.get("LFW_DIR")
+        if (root and os.path.isdir(os.path.join(root, "lfw"))) \
+                or os.environ.get("DL4J_LFW_URL"):
+            try:
+                from deeplearning4j_tpu.datasets.fetch import fetch_lfw
+                from deeplearning4j_tpu.datasets.records import (
+                    image_folder_dataset)
+
+                ds = image_folder_dataset(fetch_lfw(), 62, 47)
+                n = min(num_examples, len(ds.features))
+                return DataSet(ds.features[:n], ds.labels[:n])
+            except (IOError, ValueError) as e:
+                log.warning("LFW download/read failed (%r); falling back", e)
         try:
             from sklearn.datasets import fetch_lfw_people
 

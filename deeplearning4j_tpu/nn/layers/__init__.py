@@ -24,7 +24,7 @@ _REGISTRY = {
     LayerType.RECURSIVE_AUTOENCODER: autoencoder.AutoEncoder,
     LayerType.RBM: rbm.RBM,
     LayerType.LSTM: lstm.LSTMLayer,
-    LayerType.GRAVES_LSTM: lstm.LSTMLayer,
+    LayerType.GRAVES_LSTM: lstm.GravesLSTMLayer,
     LayerType.CONVOLUTION: conv.ConvolutionLayer,
     LayerType.SUBSAMPLING: conv.SubsamplingLayer,
     LayerType.BATCH_NORM: base.BatchNormLayer,

@@ -53,6 +53,20 @@ class OutputLayer(DenseLayer):
         return s
 
     @staticmethod
+    def score(params, conf, examples, labels):
+        """F1 of the layer's classifications on (examples, labels) —
+        reference `OutputLayer.score(INDArray, INDArray)` (:183-188: build
+        an Evaluation over labelProbabilities, return eval.f1()). Scale 0-1,
+        higher is better — distinct from `loss`, which is the training
+        objective (lower is better)."""
+        from deeplearning4j_tpu.evaluation import Evaluation
+
+        probs = OutputLayer.forward(params, conf, examples)
+        ev = Evaluation()
+        ev.eval(labels, probs)
+        return float(ev.f1())
+
+    @staticmethod
     def rowwise_loss(params, conf, x, labels, key=None, training=False):
         """Per-example loss vector, WITHOUT regularization terms (the caller
         owns those — they must be counted once per step, not per example).

@@ -306,6 +306,16 @@ class MultiLayerNetwork:
         return float(network_loss(self.conf, self.params, jnp.asarray(x),
                                   jnp.asarray(labels), key=None, training=False))
 
+    def f1_score(self, x, labels) -> float:
+        """Classification F1 on (x, labels) — the reference's
+        `OutputLayer.score(examples, labels)` (OutputLayer.java:183-188),
+        surfaced at network level: higher is better, 0..1."""
+        from deeplearning4j_tpu.evaluation import Evaluation
+
+        ev = Evaluation()
+        ev.eval(jnp.asarray(labels), self.output(x))
+        return float(ev.f1())
+
     # -- training ----------------------------------------------------------
     def _finetune_objective(self, x, labels):
         conf = self.conf

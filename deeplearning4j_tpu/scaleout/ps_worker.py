@@ -54,6 +54,9 @@ def main(argv=None) -> int:
     p.add_argument("--dataset", default="iris")
     p.add_argument("--rounds", type=int, default=5)
     p.add_argument("--timeout", type=float, default=60.0)
+    p.add_argument("--slow", type=float, default=0.0,
+                   help="sleep this many seconds per round (straggler "
+                        "simulation for async-mode tests)")
     args = p.parse_args(argv)
 
     import jax
@@ -75,11 +78,23 @@ def main(argv=None) -> int:
     # round 0 params come from the master so every worker starts identical
     net.set_params_flat(client.fetch(0))
     t0 = time.time()
+    mode = startup.get("mode", "bsp")
     for r in range(args.rounds):
+        if args.slow:
+            time.sleep(args.slow)
+        base = np.asarray(net.params_flat())  # params this fit starts from
         net.fit(x, y)                       # local iterations (conf-driven)
-        client.update(np.asarray(net.params_flat()))
-        client.progress(round=r, score=float(net.score(x, y)))
-        net.set_params_flat(client.fetch(r + 1))  # polls until published
+        if mode == "async":
+            # HogWild: ship the local delta, re-fetch the live vector —
+            # no round gate, a slow peer never blocks this loop
+            delta = np.asarray(net.params_flat()) - base
+            client.update_delta(delta)
+            client.progress(round=r, score=float(net.score(x, y)))
+            net.set_params_flat(client.fetch(0))
+        else:
+            client.update(np.asarray(net.params_flat()))
+            client.progress(round=r, score=float(net.score(x, y)))
+            net.set_params_flat(client.fetch(r + 1))  # polls til published
     client.metrics_report({"fit_seconds": time.time() - t0,
                            "rounds": float(args.rounds)})
     client.complete()

@@ -71,15 +71,27 @@ class PosTagger:
         return tags
 
 
+def default_tagger():
+    """The trained bigram-HMM tagger bundled in-package (hmm_pos.py) —
+    context-sensitive, the analog of the reference's trained UIMA models;
+    falls back to the rule lexicon if the bundled artifact is absent."""
+    try:
+        from deeplearning4j_tpu.text.hmm_pos import bundled_tagger
+
+        return bundled_tagger()
+    except (OSError, ValueError, KeyError):
+        return PosTagger()
+
+
 class PosFilterTokenizerFactory:
     """TokenizerFactory wrapper keeping only allowed parts of speech
     (`PosUimaTokenizer` contract: non-matching tokens are dropped)."""
 
     def __init__(self, base_factory, allowed_tags: Iterable[str],
-                 tagger: Optional[PosTagger] = None):
+                 tagger=None):
         self.base = base_factory
         self.allowed = set(allowed_tags)
-        self.tagger = tagger or PosTagger()
+        self.tagger = tagger or default_tagger()
 
     def tokenize(self, text: str) -> List[str]:
         toks = self.base.create(text).get_tokens()

@@ -95,3 +95,59 @@ def test_error_reporting(server):
     w.startup()
     w.error("container lost")
     assert ps.errors["wX"] == "container lost"
+
+
+@pytest.fixture
+def async_server():
+    ps = ParameterServer(np.zeros(4, np.float32), n_workers=2,
+                         iterations=3, mode="async")
+    port = ps.serve(0)
+    yield ps, f"http://127.0.0.1:{port}"
+    ps.shutdown()
+
+
+def test_async_update_applies_immediately(async_server):
+    """HogWild mode: a delta lands without waiting for other workers and
+    fetch never 409s (ref HogWildWorkRouter vs IterativeReduceWorkRouter)."""
+    ps, url = async_server
+    w0 = ParameterServerWorker(url, "w0")
+    assert w0.startup()["mode"] == "async"
+    w0.update_delta(np.ones(4, np.float32))
+    assert ps.round == 1  # applied with only 1 of 2 workers reporting
+    np.testing.assert_array_equal(w0.fetch(0), np.ones(4))
+    np.testing.assert_array_equal(w0.fetch(999), np.ones(4))  # never gated
+    # a second delta accumulates
+    w0.update_delta(2 * np.ones(4, np.float32))
+    np.testing.assert_array_equal(w0.fetch(0), 3 * np.ones(4))
+
+
+def test_async_straggler_does_not_gate(async_server):
+    """A fast worker completes many updates while a slow one sleeps."""
+    import time
+
+    ps, url = async_server
+    fast = ParameterServerWorker(url, "fast")
+    slow = ParameterServerWorker(url, "slow")
+    fast.startup(), slow.startup()
+
+    def slow_loop():
+        time.sleep(0.5)
+        slow.update_delta(np.ones(4, np.float32))
+
+    t = threading.Thread(target=slow_loop)
+    t.start()
+    for _ in range(10):  # all land before the slow worker's single one
+        fast.update_delta(0.1 * np.ones(4, np.float32))
+    assert ps.round >= 10  # never blocked on the straggler
+    t.join()
+    np.testing.assert_allclose(np.asarray(ps.fetch(0)),
+                               2.0 * np.ones(4), rtol=1e-6)
+
+
+def test_bsp_rejects_delta_updates(server):
+    ps, url = server
+    w = ParameterServerWorker(url, "w0")
+    w.startup()
+    import urllib.error
+    with pytest.raises(urllib.error.HTTPError):
+        w.update_delta(np.ones(4, np.float32))
