@@ -46,7 +46,8 @@ def _route(params, x, capacity: int):
     """Top-1 routing with capacity buckets.
 
     x: [T, d].  Returns (dispatch [T, E, C] one-hot, combine [T, E, C]
-    gate-weighted, aux_loss scalar).
+    gate-weighted, (frac [E], mean_prob [E]) aux-loss statistics — feed
+    them to `_aux_loss`, pmean-ing across shards first when sharded).
     """
     t, _ = x.shape
     e = params["router"].shape[1]
@@ -64,11 +65,19 @@ def _route(params, x, capacity: int):
     dispatch = (onehot[:, :, None] * pos_oh[:, None, :]
                 * keep[:, None, None].astype(x.dtype))  # [T, E, C]
     combine = dispatch * gate[:, None, None]
-    # Switch load-balancing aux loss: E * sum_e fraction_e * mean-prob_e
-    frac = jnp.mean(onehot, axis=0)
-    mean_prob = jnp.mean(probs, axis=0)
-    aux = e * jnp.sum(frac * mean_prob)
-    return dispatch, combine, aux
+    # Raw per-expert statistics for the Switch load-balancing aux loss
+    # E * sum_e fraction_e * mean-prob_e.  Returned unreduced so the
+    # expert-parallel caller can pmean frac/mean_prob across shards FIRST
+    # and only then take the product: per-shard frac and mean_prob are
+    # correlated, so mean-of-products != product-of-global-means.
+    frac = jnp.mean(onehot, axis=0)                     # [E]
+    mean_prob = jnp.mean(probs, axis=0)                 # [E]
+    return dispatch, combine, (frac, mean_prob)
+
+
+def _aux_loss(frac, mean_prob):
+    e = frac.shape[0]
+    return e * jnp.sum(frac * mean_prob)
 
 
 def _expert_apply(w1, b1, w2, b2, xs):
@@ -86,7 +95,8 @@ def moe_ffn_dense(params, x, capacity_factor: float = 2.0):
     t, d = x.shape
     e = params["router"].shape[1]
     capacity = max(1, int(capacity_factor * t / e))
-    dispatch, combine, aux = _route(params, x, capacity)
+    dispatch, combine, (frac, mean_prob) = _route(params, x, capacity)
+    aux = _aux_loss(frac, mean_prob)
     xs = jnp.einsum("tec,td->ecd", dispatch, x)          # [E, C, d]
     ys = _expert_apply(params["W1"], params["b1"], params["W2"],
                        params["b2"], xs[:, None])[:, 0]  # [E, C, d]
@@ -113,7 +123,8 @@ def moe_ffn(params, x, mesh: Mesh, axis: str = "ep",
     capacity = max(1, int(capacity_factor * (t // n) / e))
 
     def local(router, w1, b1, w2, b2, xs):
-        dispatch, combine, aux = _route({"router": router}, xs, capacity)
+        dispatch, combine, (frac, mean_prob) = _route(
+            {"router": router}, xs, capacity)
         buckets = jnp.einsum("tec,td->ecd", dispatch, xs)    # [E, C, d]
         buckets = buckets.reshape(n, e_loc, capacity, -1)
         # send each peer its experts' buckets; receive [e_loc, n, C, d]
@@ -126,7 +137,13 @@ def moe_ffn(params, x, mesh: Mesh, axis: str = "ep",
                               tiled=False)
         back = back.reshape(e, capacity, -1)
         y = jnp.einsum("tec,ecd->td", combine, back)
-        return xs + y, lax.pmean(aux, axis)
+        # Globalize the routing statistics BEFORE the product: with equal
+        # shard sizes pmean(frac) / pmean(mean_prob) are exactly the dense
+        # global statistics, so the aux loss (and its router gradients)
+        # match moe_ffn_dense bit-for-bit in expectation.
+        frac_g = lax.pmean(frac, axis)
+        mean_prob_g = lax.pmean(mean_prob, axis)
+        return xs + y, _aux_loss(frac_g, mean_prob_g)
 
     out = _shard_map(
         local, mesh,

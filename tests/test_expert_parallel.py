@@ -30,10 +30,37 @@ def test_ep_matches_dense_with_ample_capacity():
     mesh = make_mesh({"ep": 8})
     params, x = _setup(1)
     # capacity high enough that neither variant drops any token
-    y_dense, _ = moe_ffn_dense(params, x, capacity_factor=float(E))
-    y_ep, _ = moe_ffn(params, x, mesh, capacity_factor=float(E))
+    y_dense, aux_dense = moe_ffn_dense(params, x, capacity_factor=float(E))
+    y_ep, aux_ep = moe_ffn(params, x, mesh, capacity_factor=float(E))
     np.testing.assert_allclose(np.asarray(y_ep), np.asarray(y_dense),
                                rtol=2e-4, atol=2e-4)
+    # Aux loss must equal the DENSE global statistic, not a mean of
+    # per-shard products (the r3 MULTICHIP failure mode).
+    np.testing.assert_allclose(float(aux_ep), float(aux_dense), rtol=1e-5)
+
+
+def test_ep_full_loss_and_grads_match_dense():
+    """(y, aux) AND router/W1/W2/b1/b2 grads must match dense at 1e-4."""
+    mesh = make_mesh({"ep": 8})
+    params, x = _setup(4)
+
+    def make_loss(fn):
+        def loss(p):
+            y, aux = fn(p)
+            return jnp.mean(y ** 2) + 0.01 * aux
+        return loss
+
+    loss_ep = make_loss(lambda p: moe_ffn(p, x, mesh,
+                                          capacity_factor=float(E)))
+    loss_de = make_loss(lambda p: moe_ffn_dense(p, x,
+                                                capacity_factor=float(E)))
+    v_ep, g_ep = jax.value_and_grad(loss_ep)(params)
+    v_de, g_de = jax.value_and_grad(loss_de)(params)
+    np.testing.assert_allclose(float(v_ep), float(v_de), rtol=1e-4)
+    for k in ("router", "W1", "b1", "W2", "b2"):
+        np.testing.assert_allclose(
+            np.asarray(g_ep[k]), np.asarray(g_de[k]),
+            rtol=1e-4, atol=1e-6, err_msg=f"grad mismatch for {k}")
 
 
 def test_ep_capacity_drops_fall_through_residual():
