@@ -111,6 +111,9 @@ def cmd_train(args) -> int:
 
     props = _parse_properties(args.properties)
     epochs = int(props.get("epochs", "1"))
+    import time as _time
+    t_train = _time.perf_counter()
+    n_trained = data.num_examples() * epochs
     if args.runtime == "mesh":
         import jax
 
@@ -127,10 +130,14 @@ def cmd_train(args) -> int:
             raise SystemExit(
                 f"mesh runtime needs >= {n_dev} examples (one per device), "
                 f"got {n}")
-        dropped = sum(b.num_examples() % n_dev for b in data.batch_by(batch))
-        if dropped:
-            print(f"warning: {dropped} trailing examples/epoch dropped to "
-                  f"keep batches divisible by the {n_dev}-device dp axis",
+        remainder = sum(b.num_examples() % n_dev
+                        for b in data.batch_by(batch))
+        if remainder:
+            # remainder batches run through the pad-and-mask step (see
+            # DataParallelTrainer._step_padded): every example still
+            # trains, at the cost of one extra compiled variant
+            print(f"note: {remainder} examples/epoch take the padded-batch "
+                  f"path to stay divisible by the {n_dev}-device dp axis",
                   file=sys.stderr)
         trainer = DataParallelTrainer(
             net, mesh, mode=props.get("mode", "sync"))
@@ -140,10 +147,14 @@ def cmd_train(args) -> int:
         for _ in range(epochs):
             net.fit(data.features, data.labels)
 
+    train_seconds = _time.perf_counter() - t_train
     score = net.score(data.features, data.labels)
     checkpoint.save(args.output, net.params, conf=conf,
                     metadata={"score": score, "input": args.input})
-    print(json.dumps({"saved": args.output, "score": score}))
+    print(json.dumps({"saved": args.output, "score": score,
+                      "train_seconds": round(train_seconds, 3),
+                      "examples_per_sec": round(
+                          n_trained / max(train_seconds, 1e-9), 2)}))
     return 0
 
 

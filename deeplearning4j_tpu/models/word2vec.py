@@ -50,8 +50,12 @@ def add_adagrad_state(tables: dict) -> dict:
 
 def _w2v_step_impl(tables, centers, contexts, codes, points, code_mask,
                    neg_table, key, alpha, negative: int,
-                   use_adagrad: bool = False):
+                   use_adagrad: bool = False, weights=None):
     """One batched skip-gram SGD step; returns (tables, loss).
+
+    ``weights`` is an optional per-pair [B] multiplier (1.0 = real pair,
+    0.0 = padding) so the tail batch can be padded to a static shape
+    without double-counting any pair; None means all-ones.
 
     When ``use_adagrad`` the tables dict carries per-table accumulators
     ``h_*`` (same shape as the embedding table) and the update becomes the
@@ -63,13 +67,15 @@ def _w2v_step_impl(tables, centers, contexts, codes, points, code_mask,
     def loss_fn(tb):
         syn0, syn1, syn1neg = tb["syn0"], tb["syn1"], tb["syn1neg"]
         v_in = syn0[centers]                                  # [B, D]
+        w = jnp.ones(centers.shape[0], jnp.float32) \
+            if weights is None else weights
         total = jnp.asarray(0.0, jnp.float32)
         # hierarchical softmax over the context word's Huffman path
         nodes = syn1[points]                                  # [B, L, D]
         dots = jnp.einsum("bd,bld->bl", v_in, nodes)
         sign = 1.0 - 2.0 * codes                              # code 0 -> +1
         hs = -jax.nn.log_sigmoid(sign * dots) * code_mask
-        total = total + jnp.sum(hs)
+        total = total + jnp.sum(jnp.sum(hs, axis=1) * w)
         if negative > 0:
             B = centers.shape[0]
             # one uniform int + one gather per negative (word2vec.c table
@@ -78,10 +84,15 @@ def _w2v_step_impl(tables, centers, contexts, codes, points, code_mask,
             slots = jax.random.randint(key, (B, negative), 0,
                                        neg_table.shape[0])
             neg = neg_table[slots]
+            # word2vec.c skips target==word draws ('if (target == word)
+            # continue'): a collision would push the pair's own positive
+            # context away, so zero that term's contribution
+            no_coll = (neg != contexts[:, None]).astype(jnp.float32)
             pos_d = jnp.einsum("bd,bd->b", v_in, syn1neg[contexts])
             neg_d = jnp.einsum("bd,bkd->bk", v_in, syn1neg[neg])
-            total = total - jnp.sum(jax.nn.log_sigmoid(pos_d))
-            total = total + jnp.sum(-jax.nn.log_sigmoid(-neg_d))
+            total = total - jnp.sum(jax.nn.log_sigmoid(pos_d) * w)
+            total = total + jnp.sum(-jax.nn.log_sigmoid(-neg_d)
+                                    * no_coll * w[:, None])
         # SUM, not mean: each pair must contribute a full-strength update to
         # its embedding rows, matching the reference's per-sample SGD
         # (iterateSample applies alpha per pair, not alpha/batch)
@@ -108,12 +119,15 @@ _w2v_step = partial(jax.jit, static_argnames=("negative", "use_adagrad"),
 
 @partial(jax.jit, static_argnames=("negative", "use_adagrad"),
          donate_argnums=(0,))
-def _w2v_epoch(tables, centers_all, contexts_all, codes_all, points_all,
-               mask_all, batch_idx, neg_table, key, alphas, negative: int,
-               use_adagrad: bool = False):
+def _w2v_epoch(tables, centers_all, contexts_all, weights_all, codes_all,
+               points_all, mask_all, batch_idx, neg_table, key, alphas,
+               negative: int, use_adagrad: bool = False):
     """A whole epoch as one lax.scan over batches: all pair/vocab arrays
     live on device, so there is ONE dispatch per epoch instead of one per
-    batch (the tunnel round-trip was the bottleneck: ~20x words/sec)."""
+    batch (the tunnel round-trip was the bottleneck: ~20x words/sec).
+
+    ``weights_all`` [cap] carries 1.0 for real pairs and 0.0 for the
+    static-shape padding, so padded slots contribute nothing."""
 
     def body(carry, inp):
         tables, key = carry
@@ -124,7 +138,7 @@ def _w2v_epoch(tables, centers_all, contexts_all, codes_all, points_all,
         tables, loss = _w2v_step_impl(
             tables, centers, contexts, codes_all[contexts],
             points_all[contexts], mask_all[contexts], neg_table, sub,
-            alpha, negative, use_adagrad)
+            alpha, negative, use_adagrad, weights=weights_all[idx])
         return (tables, key), loss
 
     (tables, _), losses = jax.lax.scan(body, (tables, key),
@@ -209,15 +223,24 @@ class Word2Vec:
         # [1, window], one draw per center position
         reach = self._rng.randint(1, self.window + 1, size=n)
         offs = np.concatenate([np.arange(-self.window, 0),
-                               np.arange(1, self.window + 1)])
-        j = np.arange(n)[:, None] + offs[None, :]            # [n, 2w]
-        valid = (np.abs(offs)[None, :] <= reach[:, None]) \
-            & (j >= 0) & (j < n)
-        j_cl = np.clip(j, 0, n - 1)
-        valid &= sent[j_cl] == sent[:, None]
-        ii = np.broadcast_to(np.arange(n)[:, None], j.shape)
-        return (flat[ii[valid]].astype(np.int32),
-                flat[j_cl[valid]].astype(np.int32))
+                               np.arange(1, self.window + 1)]).astype(np.int32)
+        # chunk the position axis so the [chunk, 2w] grids stay bounded
+        # (~8*window bytes/position peak instead of 40*window for the
+        # whole corpus at once — multi-GB at 10M+ tokens)
+        cen_parts, ctx_parts = [], []
+        chunk = 1 << 20
+        for s in range(0, n, chunk):
+            e = min(s + chunk, n)
+            j = np.arange(s, e, dtype=np.int32)[:, None] + offs[None, :]
+            valid = (np.abs(offs)[None, :] <= reach[s:e, None]) \
+                & (j >= 0) & (j < n)
+            j_cl = np.clip(j, 0, n - 1)
+            valid &= sent[j_cl] == sent[s:e, None]
+            ii = np.broadcast_to(np.arange(s, e, dtype=np.int32)[:, None],
+                                 j.shape)
+            cen_parts.append(flat[ii[valid]].astype(np.int32))
+            ctx_parts.append(flat[j_cl[valid]].astype(np.int32))
+        return np.concatenate(cen_parts), np.concatenate(ctx_parts)
 
     # -- training ----------------------------------------------------------
     def fit(self, sentences=None) -> "Word2Vec":
@@ -248,39 +271,60 @@ class Word2Vec:
             add_adagrad_state(tables)
         key = jax.random.PRNGKey(self.seed)
 
+        # fresh pair draw per epoch (Word2Vec.java re-rolls the window
+        # shrink b = rand % window and the subsampling keep-coin on every
+        # pass — r3 froze one draw for all epochs).  Draws happen lazily,
+        # one epoch at a time (O(1-epoch) host memory even at 10M+
+        # tokens); the static capacity starts 2% above epoch 1's count so
+        # later epochs' slightly larger draws almost never change the
+        # padded shape — at worst a bigger draw costs one re-compile
         centers, contexts = self._pairs(ids_per_sentence)
-        n_pairs = len(centers)
-        if n_pairs == 0:
+        if len(centers) == 0:
             log.warning("word2vec: no training pairs")
             return self
         B = self.batch_size
-        k_steps = (n_pairs - 1) // B + 1
+        k_steps = (int(len(centers) * 1.02) - 1) // B + 1
+        cap = k_steps * B
         steps_total = max(1, self.epochs * k_steps)
-        # everything the epoch needs lives on device once; each epoch is a
-        # single dispatch of a lax.scan over its batches
-        centers_dev = jnp.asarray(centers)
-        contexts_dev = jnp.asarray(contexts)
+        # vocab-side arrays live on device once
         codes_dev = jnp.asarray(codes_all)
         points_dev = jnp.asarray(points_all)
         mask_dev = jnp.asarray(mask_all)
         step_i = 0
         for epoch in range(self.epochs):
-            perm = self._rng.permutation(n_pairs)
-            if n_pairs % B:  # pad the tail batch to a static shape; resize
-                # wraps cyclically, so it works even when the pad needed
-                # exceeds n_pairs (tiny corpus, n_pairs < B)
-                perm = np.resize(perm, k_steps * B)
-            batch_idx = jnp.asarray(perm.reshape(k_steps, B))
-            # linear alpha decay (Word2Vec.java alpha schedule)
-            alphas = jnp.asarray(np.maximum(
-                self.min_alpha,
-                self.alpha * (1 - (step_i + np.arange(k_steps))
-                              / steps_total)), jnp.float32)
+            if epoch > 0:
+                centers, contexts = self._pairs(ids_per_sentence)
+            n_pairs = len(centers)
+            if n_pairs > cap:  # rare: this draw outgrew the capacity
+                k_steps = (n_pairs - 1) // B + 1
+                cap = k_steps * B
+            # pad to the static capacity with weight-0 slots: every real
+            # pair is applied EXACTLY once per epoch (np.resize used to
+            # wrap cyclically, double-counting head pairs in the tail)
+            pad = cap - n_pairs
+            centers_dev = jnp.asarray(np.pad(centers, (0, pad)))
+            contexts_dev = jnp.asarray(np.pad(contexts, (0, pad)))
+            weights_dev = jnp.asarray(
+                (np.arange(cap) < n_pairs).astype(np.float32))
+            batch_idx = jnp.asarray(
+                self._rng.permutation(cap).reshape(k_steps, B))
+            if self.use_adagrad:
+                # AdaGrad already scales each step by accumulated history;
+                # the reference's AdaGrad path uses the FIXED configured lr
+                # (InMemoryLookupTable getGradient), so don't compound the
+                # linear decay on top of it
+                alphas = jnp.full(k_steps, self.alpha, jnp.float32)
+            else:
+                # linear alpha decay (Word2Vec.java alpha schedule)
+                alphas = jnp.asarray(np.maximum(
+                    self.min_alpha,
+                    self.alpha * (1 - (step_i + np.arange(k_steps))
+                                  / steps_total)), jnp.float32)
             key, sub = jax.random.split(key)
             tables, losses = _w2v_epoch(
-                tables, centers_dev, contexts_dev, codes_dev, points_dev,
-                mask_dev, batch_idx, neg_table, sub, alphas, self.negative,
-                self.use_adagrad)
+                tables, centers_dev, contexts_dev, weights_dev, codes_dev,
+                points_dev, mask_dev, batch_idx, neg_table, sub, alphas,
+                self.negative, self.use_adagrad)
             step_i += k_steps
         self.table.syn0 = tables["syn0"]
         self.table.syn1 = tables["syn1"]

@@ -1,0 +1,83 @@
+"""Orchestration tests for bench.py (r3 weak #1 regression guards).
+
+Round 3 shipped zero metrics because one timeout discarded the child's
+partial stdout and consumed the whole driver budget.  These tests pin the
+fixed behavior: streamed partial metrics survive a killed child, retries
+resume from the skip-list instead of restarting, and a full SMALL run
+emits every metric with rc=0.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BENCH = os.path.join(REPO, "bench.py")
+
+
+def _env(**extra):
+    env = dict(os.environ)
+    env.update({"DL4J_BENCH_SMALL": "1", "JAX_PLATFORMS": "cpu",
+                "DL4J_BENCH_PLATFORM": "cpu",
+                "XLA_FLAGS": "--xla_force_host_platform_device_count=8"})
+    env.update(extra)
+    return env
+
+
+@pytest.mark.slow
+def test_small_suite_emits_all_metrics_rc0():
+    proc = subprocess.run([sys.executable, BENCH], env=_env(),
+                          capture_output=True, text=True, timeout=900)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    lines = [json.loads(l) for l in proc.stdout.splitlines() if l.strip()]
+    metrics = {l["metric"] for l in lines}
+    assert len(lines) == len(metrics), "duplicate metric lines"
+    # every line is driver-parseable: metric/value/unit/vs_baseline keys
+    for l in lines:
+        assert {"metric", "value", "unit", "vs_baseline"} <= set(l)
+        assert "__done__" not in l
+    # BASELINE five + heavyweights (north-star CLI emits two lines)
+    expected_frags = ["LeNet5-MNIST", "charLSTM-PTB", "VGG-CIFAR10",
+                      "Word2Vec", "all-reduce", "charLSTM-4layer",
+                      "north-star CLI LeNet-MNIST",
+                      "north-star CLI charLSTM-4layer", "charTransformer"]
+    for frag in expected_frags:
+        assert any(frag in m for m in metrics), f"missing metric: {frag}"
+
+
+@pytest.mark.slow
+def test_partial_metrics_survive_attempt_timeout():
+    """Kill the child mid-suite: already-emitted metrics must still be on
+    the parent's stdout (the exact r3 failure mode)."""
+    # 45s per attempt: enough for the first bench or two in SMALL mode on
+    # CPU, not the whole suite; single attempt so the run stays short
+    proc = subprocess.run(
+        [sys.executable, BENCH],
+        env=_env(DL4J_BENCH_ATTEMPT_S="45", DL4J_BENCH_PER_BENCH_S="40"),
+        capture_output=True, text=True, timeout=300)
+    lines = [json.loads(l) for l in proc.stdout.splitlines() if l.strip()]
+    # whatever completed before the kill was forwarded, not discarded
+    if lines:
+        for l in lines:
+            assert "metric" in l
+    # resume across attempts is reported on stderr
+    assert "benches done" in proc.stderr or proc.returncode == 0
+
+
+def test_skip_env_resumes_instead_of_restarting():
+    """With every bench pre-marked done, the suite exits 0 instantly
+    without claiming a device (proves the skip-list short-circuit)."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location("bench_mod", BENCH)
+    bench_mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench_mod)
+    skip = ",".join(b.__name__ for b in bench_mod.BENCHES)
+    proc = subprocess.run(
+        [sys.executable, BENCH], env=_env(DL4J_BENCH_SKIP=skip),
+        capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0
+    assert proc.stdout.strip() == ""
