@@ -47,7 +47,12 @@ import numpy as np
 _CHILD_ENV = "DL4J_BENCH_CHILD"
 _SKIP_ENV = "DL4J_BENCH_SKIP"
 _DEADLINE_ENV = "DL4J_BENCH_DEADLINE"
+# post-claim run budget per attempt; the device-claim phase gets its own
+# separate allowance because the axon tunnel claim can take minutes when
+# the pool is contended — claim time must not eat the measuring budget
 ATTEMPT_TIMEOUT_S = int(os.environ.get("DL4J_BENCH_ATTEMPT_S", "420"))
+CLAIM_TIMEOUT_S = int(os.environ.get("DL4J_BENCH_CLAIM_S", "420"))
+GLOBAL_BUDGET_S = int(os.environ.get("DL4J_BENCH_TOTAL_S", "1380"))
 PER_BENCH_BUDGET_S = int(os.environ.get("DL4J_BENCH_PER_BENCH_S", "300"))
 MAX_ATTEMPTS = 3
 RETRY_PAUSE_S = 10
@@ -242,9 +247,13 @@ def bench_vgg_cifar10(devs) -> None:
     net = MultiLayerNetwork(conf, seed=0).init()
     trainer = DataParallelTrainer(net, mesh, mode="sync")
 
-    rng = np.random.RandomState(0)
-    x = jnp.asarray(rng.rand(batch, 3 * 32 * 32), jnp.float32)
-    y = jnp.asarray(np.eye(10, dtype=np.float32)[rng.randint(0, 10, batch)])
+    # real CIFAR-10 when a local copy/source exists, class-separable
+    # synthetic otherwise (datasets/cifar.py) — not pure noise
+    from deeplearning4j_tpu.datasets.fetchers import Cifar10DataFetcher
+
+    data = Cifar10DataFetcher().fetch(batch)
+    x = jnp.asarray(data.features[:batch], jnp.float32)
+    y = jnp.asarray(data.labels[:batch], jnp.float32)
     x, y = shard_batch(mesh, (x, y), "dp")
 
     key = jax.random.PRNGKey(0)
@@ -529,10 +538,15 @@ BASELINE_FIVE = {"bench_lenet", "bench_char_lstm", "bench_vgg_cifar10",
 
 def run_child() -> int:
     skip = set(filter(None, os.environ.get(_SKIP_ENV, "").split(",")))
-    deadline = float(os.environ.get(_DEADLINE_ENV, "0")) or (
+    global_deadline = float(os.environ.get(_DEADLINE_ENV, "0")) or (
         time.time() + 86400.0)
     devs = _devices_with_retry(
-        max_wait=max(60.0, deadline - time.time() - 60.0))
+        max_wait=max(60.0, global_deadline - time.time() - 60.0))
+    # the run budget starts NOW — claim time (potentially minutes of pool
+    # contention) is excluded; the control line tells the parent to switch
+    # from the claim allowance to the run budget
+    deadline = min(global_deadline, time.time() + ATTEMPT_TIMEOUT_S)
+    print(json.dumps({"__devices__": len(devs)}), flush=True)
     print(f"bench: {len(devs)} device(s), kind={devs[0].device_kind}",
           file=sys.stderr, flush=True)
 
@@ -571,15 +585,18 @@ def run_child() -> int:
     return 0 if ok else 1
 
 
-def _stream_attempt(env: dict, done: set, forwarded: set) -> None:
+def _stream_attempt(env: dict, done: set, forwarded: set,
+                    global_deadline: float) -> None:
     """One child attempt; forward fresh metric lines as they appear.
 
     Lines reach our stdout the moment the child prints them, so a hang or
-    parent-side kill can no longer discard already-measured metrics."""
+    parent-side kill can no longer discard already-measured metrics.  The
+    attempt deadline starts at the claim allowance and is extended to the
+    run budget when the child reports its devices claimed."""
     env = dict(env)
     env[_CHILD_ENV] = "1"
     env[_SKIP_ENV] = ",".join(sorted(done))
-    env[_DEADLINE_ENV] = str(time.time() + ATTEMPT_TIMEOUT_S - 15)
+    env[_DEADLINE_ENV] = str(global_deadline - 15)
     proc = subprocess.Popen(
         [sys.executable, "-u", os.path.abspath(__file__)], env=env,
         cwd=os.path.dirname(os.path.abspath(__file__)) or ".",
@@ -592,13 +609,30 @@ def _stream_attempt(env: dict, done: set, forwarded: set) -> None:
         q.put(None)
 
     threading.Thread(target=_reader, daemon=True).start()
-    deadline = time.time() + ATTEMPT_TIMEOUT_S
+
+    def _handle(line) -> None:
+        try:
+            obj = json.loads(line)
+        except ValueError:
+            return
+        if not isinstance(obj, dict):
+            return
+        if "__done__" in obj:
+            done.add(obj["__done__"])
+        elif "metric" in obj and obj["metric"] not in forwarded:
+            forwarded.add(obj["metric"])
+            sys.stdout.write(line)
+            sys.stdout.flush()
+
+    deadline = min(global_deadline, time.time() + CLAIM_TIMEOUT_S)
+    claimed = False
     while True:
         try:
             line = q.get(timeout=max(0.1, deadline - time.time()))
         except queue.Empty:
-            print(f"bench: attempt timed out after {ATTEMPT_TIMEOUT_S}s; "
-                  "killing child (metrics so far already forwarded)",
+            phase = "run budget" if claimed else "device-claim allowance"
+            print(f"bench: attempt exceeded its {phase}; killing child "
+                  "(metrics so far already forwarded)",
                   file=sys.stderr, flush=True)
             proc.kill()
             break
@@ -607,15 +641,22 @@ def _stream_attempt(env: dict, done: set, forwarded: set) -> None:
         try:
             obj = json.loads(line)
         except ValueError:
+            obj = None
+        if isinstance(obj, dict) and "__devices__" in obj and not claimed:
+            claimed = True
+            deadline = min(global_deadline,
+                           time.time() + ATTEMPT_TIMEOUT_S + 15)
             continue
-        if not isinstance(obj, dict):
-            continue
-        if "__done__" in obj:
-            done.add(obj["__done__"])
-        elif "metric" in obj and obj["metric"] not in forwarded:
-            forwarded.add(obj["metric"])
-            sys.stdout.write(line)
-            sys.stdout.flush()
+        _handle(line)
+    # drain anything the reader enqueued between the timeout and the kill
+    # (a metric/__done__ printed right at the deadline must not be lost)
+    while True:
+        try:
+            line = q.get_nowait()
+        except queue.Empty:
+            break
+        if line is not None:
+            _handle(line)
     try:
         proc.wait(timeout=30)
     except subprocess.TimeoutExpired:
@@ -628,10 +669,15 @@ def main() -> int:
     all_names = {b.__name__ for b in BENCHES}
     done: set = set(filter(None, os.environ.get(_SKIP_ENV, "").split(",")))
     forwarded: set = set()
+    global_deadline = time.time() + GLOBAL_BUDGET_S
     for attempt in range(1, MAX_ATTEMPTS + 1):
         if done >= all_names:
             return 0
-        _stream_attempt(os.environ, done, forwarded)
+        if global_deadline - time.time() < 90:
+            print("bench: global budget exhausted", file=sys.stderr,
+                  flush=True)
+            break
+        _stream_attempt(os.environ, done, forwarded, global_deadline)
         if done >= all_names:
             return 0
         print(f"bench attempt {attempt}: {len(done)}/{len(all_names)} "

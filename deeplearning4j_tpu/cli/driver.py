@@ -201,7 +201,7 @@ def cmd_predict(args) -> int:
 
 def _add_common(p: argparse.ArgumentParser) -> None:
     p.add_argument("--input", required=True,
-                   help="mnist|iris|lfw|curves|csv:<path>[:label_col]|"
+                   help="mnist|iris|lfw|curves|cifar10|csv:<path>[:label_col]|"
                         "text:<path>[:seq_len]|*.csv")
     p.add_argument("--model", default=None,
                    help="conf JSON (train) or checkpoint dir (test/predict)")

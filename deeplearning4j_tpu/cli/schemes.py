@@ -14,11 +14,11 @@ from typing import Optional
 
 from deeplearning4j_tpu.datasets.dataset import DataSet
 from deeplearning4j_tpu.datasets.fetchers import (
-    CSVDataFetcher, CurvesDataFetcher, IrisDataFetcher, LFWDataFetcher,
-    MnistDataFetcher)
+    Cifar10DataFetcher, CSVDataFetcher, CurvesDataFetcher, IrisDataFetcher,
+    LFWDataFetcher, MnistDataFetcher)
 
 _BUILTIN_DEFAULT_N = {"mnist": 10000, "iris": 150, "lfw": 1000,
-                      "curves": 1000}
+                      "curves": 1000, "cifar10": 10000}
 
 
 def load_input(uri: str, label_column: int = -1,
@@ -30,7 +30,8 @@ def load_input(uri: str, label_column: int = -1,
     if scheme in _BUILTIN_DEFAULT_N:
         n = num_examples or (int(rest) if rest else _BUILTIN_DEFAULT_N[scheme])
         fetcher = {"mnist": MnistDataFetcher, "iris": IrisDataFetcher,
-                   "lfw": LFWDataFetcher, "curves": CurvesDataFetcher}[scheme]()
+                   "lfw": LFWDataFetcher, "curves": CurvesDataFetcher,
+                   "cifar10": Cifar10DataFetcher}[scheme]()
         return fetcher.fetch(n)
 
     if scheme == "csv" or uri.endswith(".csv"):

@@ -27,9 +27,18 @@ from typing import Dict, Optional
 log = logging.getLogger("deeplearning4j_tpu")
 
 # canonical sources (the reference's trainingFilesURL etc.); override with
-# base_url= or the DL4J_MNIST_URL / DL4J_LFW_URL environment variables
+# base_url= or the DL4J_MNIST_URL / DL4J_LFW_URL / DL4J_CIFAR10_URL /
+# DL4J_CURVES_URL environment variables
 MNIST_BASE_URL = "http://yann.lecun.com/exdb/mnist/"
 LFW_URL = "http://vis-www.cs.umass.edu/lfw/lfw.tgz"
+CIFAR10_URL = "https://www.cs.toronto.edu/~kriz/cifar-10-python.tar.gz"
+# published digest of the canonical cifar-10-python.tar.gz
+CIFAR10_SHA256 = \
+    "6d958be074577803d12ecdefd02955f39262c83c16fe9348329d7fe0b5c001ce"
+# the reference's CurvesDataFetcher pulls a serialized corpus from S3
+# (CurvesDataFetcher.java:38-65 CURVES_URL); the Java-serialized .ser is
+# replaced by an .npz with 'features' (+ optional 'labels') arrays
+CURVES_URL = ""  # no canonical public .npz source; set DL4J_CURVES_URL
 
 MNIST_FILES = ("train-images-idx3-ubyte.gz", "train-labels-idx1-ubyte.gz",
                "t10k-images-idx3-ubyte.gz", "t10k-labels-idx1-ubyte.gz")
@@ -162,6 +171,58 @@ def fetch_mnist(cache_dir: Optional[str] = None,
     return cache_dir
 
 
+def fetch_cifar10(cache_dir: Optional[str] = None,
+                  url: Optional[str] = None,
+                  sha256: Optional[str] = "default",
+                  retries: int = 3) -> str:
+    """Download + untar `cifar-10-python.tar.gz`; returns the
+    `cifar-10-batches-py` directory ready for `cifar.load_real_cifar10`.
+
+    cache_dir defaults to $CIFAR10_DIR or ~/CIFAR10; url to
+    $DL4J_CIFAR10_URL or the canonical Toronto server.  sha256 defaults to
+    the canonical digest — pass None to skip verification for a fixture
+    archive with different bytes.
+    """
+    from deeplearning4j_tpu.datasets.cifar import BATCH_DIR, TRAIN_BATCHES
+
+    cache_dir = cache_dir or os.environ.get("CIFAR10_DIR") \
+        or os.path.expanduser("~/CIFAR10")
+    url = url or os.environ.get("DL4J_CIFAR10_URL") or CIFAR10_URL
+    if sha256 == "default":
+        # a non-canonical source (mirror/fixture) has different bytes;
+        # only pin the digest when pulling from the canonical URL
+        sha256 = CIFAR10_SHA256 if url == CIFAR10_URL else None
+    root = os.path.join(cache_dir, BATCH_DIR)
+    if os.path.exists(os.path.join(root, TRAIN_BATCHES[0])):
+        return root
+    tgz = download_file(url, os.path.join(cache_dir, os.path.basename(url)),
+                        sha256=sha256, retries=retries)
+    untar_file(tgz, cache_dir)
+    if not os.path.exists(os.path.join(root, TRAIN_BATCHES[0])):
+        # archive laid out without the cifar-10-batches-py/ prefix
+        root = cache_dir
+    return root
+
+
+def fetch_curves(cache_dir: Optional[str] = None, url: Optional[str] = None,
+                 sha256: Optional[str] = None, retries: int = 3) -> str:
+    """Download the curves corpus (.npz with 'features' [+ 'labels']);
+    returns the local file path.
+
+    The reference's `CurvesDataFetcher.java:38-65` downloads and
+    deserializes a Java `curves.ser` DataSet; the TPU-native corpus format
+    is an .npz archive.  url defaults to $DL4J_CURVES_URL (there is no
+    canonical public .npz mirror)."""
+    cache_dir = cache_dir or os.environ.get("CURVES_DIR") \
+        or os.path.expanduser("~/CURVES")
+    url = url or os.environ.get("DL4J_CURVES_URL") or CURVES_URL
+    if not url:
+        raise IOError("no curves source configured (set DL4J_CURVES_URL)")
+    return download_file(
+        url, os.path.join(cache_dir, os.path.basename(url)),
+        sha256=sha256, retries=retries)
+
+
 def fetch_lfw(cache_dir: Optional[str] = None, url: Optional[str] = None,
               sha256: Optional[str] = None, retries: int = 3) -> str:
     """Download + untar LFW (`base/LFWLoader.getIfNotExists`); returns the
@@ -169,11 +230,18 @@ def fetch_lfw(cache_dir: Optional[str] = None, url: Optional[str] = None,
     cache_dir = cache_dir or os.environ.get("LFW_DIR") \
         or os.path.expanduser("~/LFW")
     url = url or os.environ.get("DL4J_LFW_URL") or LFW_URL
+    # already-extracted trees win before any network touch (the reference's
+    # `if(!tarFile.isFile())` skip, extended to the extracted form): either
+    # the lfw/-prefixed layout or a flat person-per-directory cache_dir
+    root = os.path.join(cache_dir, "lfw")
+    if os.path.isdir(root):
+        return root
+    if os.path.isdir(cache_dir) and any(
+            e.is_dir() for e in os.scandir(cache_dir)):
+        return cache_dir
     tgz = download_file(url, os.path.join(cache_dir, os.path.basename(url)),
                         sha256=sha256, retries=retries)
-    root = os.path.join(cache_dir, "lfw")
-    if not os.path.isdir(root):
-        untar_file(tgz, cache_dir)
+    untar_file(tgz, cache_dir)
     if not os.path.isdir(root):  # archive laid out without a lfw/ prefix
         root = cache_dir
     return root

@@ -84,10 +84,11 @@ class LFWDataFetcher(BaseDataFetcher):
     def fetch(self, num_examples: int = 1000) -> DataSet:
         # preferred real path (LFWLoader.java parity): a downloaded (or
         # pre-existing) person-per-directory image tree read through
-        # ImageRecordReader; falls back to the sklearn cache, then synthetic
+        # ImageRecordReader; falls back to the sklearn cache, then synthetic.
+        # Gate on LFW_DIR being a directory at all — fetch_lfw itself
+        # handles both the lfw/-prefixed and flat archive layouts
         root = os.environ.get("LFW_DIR")
-        if (root and os.path.isdir(os.path.join(root, "lfw"))) \
-                or os.environ.get("DL4J_LFW_URL"):
+        if (root and os.path.isdir(root)) or os.environ.get("DL4J_LFW_URL"):
             try:
                 from deeplearning4j_tpu.datasets.fetch import fetch_lfw
                 from deeplearning4j_tpu.datasets.records import (
@@ -115,12 +116,48 @@ class LFWDataFetcher(BaseDataFetcher):
         return DataSet(X[:n].reshape(n, -1), labels_to_one_hot(y[:n], k))
 
 
+class Cifar10DataFetcher(BaseDataFetcher):
+    """CIFAR-10 (BASELINE configs[2]): real batches when a local copy or a
+    configured source exists, deterministic synthetic stand-in otherwise.
+    The reference has no CIFAR fetcher at all — this exceeds it."""
+
+    def __init__(self, train: bool = True):
+        self.train = train
+
+    def fetch(self, num_examples: int = 50000) -> DataSet:
+        from deeplearning4j_tpu.datasets import cifar
+
+        X = None
+        try:
+            d = cifar.find_cifar10_dir()
+            if d is None and os.environ.get("DL4J_CIFAR10_URL"):
+                from deeplearning4j_tpu.datasets.fetch import fetch_cifar10
+
+                d = fetch_cifar10()
+            if d is not None:
+                X, y = cifar.load_real_cifar10(d, self.train, num_examples)
+        except Exception as e:  # noqa: BLE001 — corrupt archive/pickle/...
+            # tarfile.ReadError, pickle errors etc. are NOT IOErrors; any
+            # acquisition failure must land on the synthetic path, not
+            # crash the caller
+            log.warning("CIFAR-10 acquisition failed (%r); using synthetic",
+                        e)
+        if X is None:
+            X, y = cifar.synthetic_cifar10(num_examples)
+        return DataSet(X, labels_to_one_hot(y, 10))
+
+
 class CurvesDataFetcher(BaseDataFetcher):
-    """Synthetic 'curves' dataset (ref downloads a fixed curves.json corpus):
-    smooth random 1-d curves rasterized to 784 features, autoencoder-style
+    """Curves corpus: real .npz when $CURVES_DIR holds one (or
+    $DL4J_CURVES_URL is configured — `fetch.fetch_curves`, the analog of
+    CurvesDataFetcher.java:38-65's S3 download); otherwise synthetic smooth
+    random 1-d curves rasterized to 784 features, autoencoder-style
     (labels == features)."""
 
     def fetch(self, num_examples: int = 1000) -> DataSet:
+        real = self._fetch_real(num_examples)
+        if real is not None:
+            return real
         rng = np.random.RandomState(42)
         t = np.linspace(0, 1, 784, dtype=np.float32)
         freqs = rng.rand(num_examples, 3) * 8
@@ -131,6 +168,30 @@ class CurvesDataFetcher(BaseDataFetcher):
             X += amps[:, i:i + 1] * np.sin(2 * np.pi * freqs[:, i:i + 1] * t + phases[:, i:i + 1])
         X = (X - X.min()) / (X.max() - X.min() + 1e-6)
         return DataSet(X, X.copy())
+
+    def _fetch_real(self, num_examples: int) -> Optional[DataSet]:
+        """Locate (or download) a curves .npz; None -> synthetic path."""
+        path = None
+        d = os.environ.get("CURVES_DIR")
+        if d and os.path.isdir(d):
+            for name in sorted(os.listdir(d)):
+                if name.endswith(".npz"):
+                    path = os.path.join(d, name)
+                    break
+        if path is None and os.environ.get("DL4J_CURVES_URL"):
+            from deeplearning4j_tpu.datasets.fetch import fetch_curves
+
+            try:
+                path = fetch_curves()
+            except IOError as e:
+                log.warning("curves download failed (%r); using synthetic", e)
+        if path is None:
+            return None
+        with np.load(path) as z:
+            X = np.asarray(z["features"], np.float32)[:num_examples]
+            y = (np.asarray(z["labels"], np.float32)[:num_examples]
+                 if "labels" in z else X.copy())
+        return DataSet(X, y)
 
 
 class CSVDataFetcher(BaseDataFetcher):
@@ -191,3 +252,9 @@ def lfw_iterator(batch_size: int = 10, num_examples: int = 300) -> DataSetIterat
 
 def curves_iterator(batch_size: int = 10, num_examples: int = 300) -> DataSetIterator:
     return ListDataSetIterator(CurvesDataFetcher().fetch(num_examples), batch_size)
+
+
+def cifar10_iterator(batch_size: int = 10, num_examples: int = 1000,
+                     train: bool = True) -> DataSetIterator:
+    return ListDataSetIterator(
+        Cifar10DataFetcher(train).fetch(num_examples), batch_size)

@@ -3,8 +3,9 @@
 Parity: reference `datasets/iterator/DataSetIterator.java:54` (batch(),
 totalExamples(), inputColumns(), reset(), cursor) and the wrappers in
 `datasets/iterator/` — `ListDataSetIterator`, `SamplingDataSetIterator`,
-`MultipleEpochsIterator`, and the test-support `TestDataSetIterator`
-(`datasets/test/TestDataSetIterator.java`).
+`MultipleEpochsIterator`, `ReconstructionDataSetIterator`,
+`MovingWindowBaseDataSetIterator`, and the test-support
+`TestDataSetIterator` (`datasets/test/TestDataSetIterator.java`).
 """
 
 from __future__ import annotations
@@ -131,6 +132,84 @@ class MultipleEpochsIterator(DataSetIterator):
             self._epoch += 1
         self.cursor += num or self.batch_size
         return self.base.next(num)
+
+
+class ReconstructionDataSetIterator(DataSetIterator):
+    """Serves each batch with labels := features, turning any iterator into
+    an autoencoder/RBM pretraining stream
+    (`datasets/iterator/ReconstructionDataSetIterator.java:46-49`:
+    `ret.setLabels(ret.getFeatureMatrix())`)."""
+
+    def __init__(self, base: DataSetIterator):
+        super().__init__(base.batch_size, base.total_examples())
+        self.base = base
+
+    def input_columns(self) -> int:
+        return self.base.input_columns()
+
+    def total_outcomes(self) -> int:
+        # reconstruction target = the features themselves
+        return self.base.input_columns()
+
+    def reset(self) -> None:
+        super().reset()
+        self.base.reset()
+
+    def has_next(self) -> bool:
+        return self.base.has_next()
+
+    def next(self, num: Optional[int] = None) -> DataSet:
+        d = self.base.next(num)
+        self.cursor = self.base.cursor
+        return DataSet(d.features, np.array(d.features, copy=True))
+
+
+def moving_window_dataset(data: DataSet, window_rows: int,
+                          window_cols: int, rotate: bool = True) -> DataSet:
+    """Tile every image into all non-overlapping window_rows x window_cols
+    patches (plus, when square, their 90/180/270-degree rotations), each
+    labeled with the source image's label.
+
+    Capability parity with `util/MovingWindowMatrix.java` +
+    `iterator/impl/MovingWindowDataSetFetcher.java` (window extraction +
+    addRotate augmentation), redesigned for static shapes: the reference
+    merges wr*wc-column windows with the H*W-column originals into one
+    DataSet (ragged rows); here every row is a window of one homogeneous
+    shape, which is what an XLA-compiled conv stack can consume."""
+    n, d = data.features.shape
+    side = int(round(d ** 0.5))
+    if side * side != d:
+        raise ValueError(f"features ({d} columns) are not square images")
+    if side % window_rows or side % window_cols:
+        raise ValueError(f"{side}x{side} images do not tile into "
+                         f"{window_rows}x{window_cols} windows")
+    imgs = data.features.reshape(n, side // window_rows, window_rows,
+                                 side // window_cols, window_cols)
+    # [n, tiles, wr, wc]
+    tiles = imgs.transpose(0, 1, 3, 2, 4).reshape(
+        n, -1, window_rows, window_cols)
+    variants = [tiles]
+    if rotate and window_rows == window_cols:
+        for k in (1, 2, 3):
+            variants.append(np.rot90(tiles, k=k, axes=(2, 3)))
+    stacked = np.concatenate(variants, axis=1)          # [n, v*tiles, wr, wc]
+    per_img = stacked.shape[1]
+    feats = np.ascontiguousarray(stacked).reshape(
+        n * per_img, window_rows * window_cols)
+    labels = np.repeat(data.labels, per_img, axis=0)
+    return DataSet(feats.astype(np.float32), labels)
+
+
+class MovingWindowBaseDataSetIterator(ListDataSetIterator):
+    """Batches over the moving-window augmentation of `data`
+    (`datasets/iterator/MovingWindowBaseDataSetIterator.java` wiring a
+    MovingWindowDataSetFetcher)."""
+
+    def __init__(self, data: DataSet, window_rows: int, window_cols: int,
+                 batch_size: int = 10, rotate: bool = True):
+        super().__init__(
+            moving_window_dataset(data, window_rows, window_cols, rotate),
+            batch_size)
 
 
 class TestDataSetIterator(DataSetIterator):

@@ -90,13 +90,17 @@ class ParameterServer:
         with self._lock:
             if self.mode == "async":
                 # HogWild: apply immediately against whatever is current —
-                # no banking, no worker-count gate. Deltas add; a full
-                # vector replaces (a late full write is last-writer-wins,
-                # exactly the reference's lock-free table semantics).
-                if kind == "delta":
-                    self.current = self.current + np.asarray(vec)
-                else:
-                    self.current = np.asarray(vec)
+                # no banking, no worker-count gate. Only deltas compose
+                # under concurrency; a full-vector write would silently
+                # last-writer-win over every other worker's applied deltas
+                # (ps_worker's async path only ever sends deltas), so
+                # reject it loudly — the mirror of the bsp delta rejection
+                if kind != "delta":
+                    raise ValueError(
+                        "full-vector updates require mode='bsp'; async "
+                        "workers must send update_delta() so concurrent "
+                        "progress is never discarded")
+                self.current = self.current + np.asarray(vec)
                 self.round += 1
                 return {"round": self.round}
             if kind == "delta":

@@ -1,0 +1,76 @@
+"""Iterator-wrapper tests: ReconstructionDataSetIterator and
+MovingWindowBaseDataSetIterator (VERDICT r3 missing #3)."""
+
+import numpy as np
+
+from deeplearning4j_tpu.datasets.dataset import DataSet, labels_to_one_hot
+from deeplearning4j_tpu.datasets.iterator import (
+    ListDataSetIterator, MovingWindowBaseDataSetIterator,
+    ReconstructionDataSetIterator, moving_window_dataset)
+
+
+def _ds(n=12, d=16, classes=3, seed=0):
+    rng = np.random.RandomState(seed)
+    return DataSet(rng.rand(n, d).astype(np.float32),
+                   labels_to_one_hot(rng.randint(0, classes, n), classes))
+
+
+def test_reconstruction_iterator_sets_labels_to_features():
+    data = _ds()
+    it = ReconstructionDataSetIterator(ListDataSetIterator(data, 5))
+    batches = list(it)
+    assert sum(b.num_examples() for b in batches) == 12
+    for b in batches:
+        np.testing.assert_array_equal(b.labels, b.features)
+        assert b.labels is not b.features  # a copy, not an alias
+    assert it.total_outcomes() == it.input_columns() == 16
+    # reset replays identically
+    it.reset()
+    again = list(it)
+    np.testing.assert_array_equal(again[0].features, batches[0].features)
+
+
+def test_moving_window_tiles_and_rotations():
+    # one 4x4 image with distinct quadrant values, 2x2 windows
+    img = np.array([[1, 1, 2, 2],
+                    [1, 1, 2, 2],
+                    [3, 3, 4, 4],
+                    [3, 3, 4, 4]], np.float32).reshape(1, 16)
+    data = DataSet(img, labels_to_one_hot([1], 2))
+    out = moving_window_dataset(data, 2, 2, rotate=False)
+    # 4 tiles, each constant-valued (the MovingWindowMatrix.java docstring
+    # example: 1 1 2 2 / 3 3 4 4 quadrants -> flattened windows)
+    assert out.features.shape == (4, 4)
+    tile_vals = sorted(set(out.features.ravel().tolist()))
+    assert tile_vals == [1.0, 2.0, 3.0, 4.0]
+    for row in out.features:
+        assert len(set(row.tolist())) == 1
+    # every window inherits the source label
+    np.testing.assert_array_equal(out.labels,
+                                  np.repeat(data.labels, 4, axis=0))
+
+    # addRotate=true quadruples the windows (90/180/270 variants)
+    rot = moving_window_dataset(data, 2, 2, rotate=True)
+    assert rot.features.shape == (16, 4)
+
+
+def test_moving_window_iterator_batches():
+    rng = np.random.RandomState(1)
+    data = DataSet(rng.rand(6, 36).astype(np.float32),
+                   labels_to_one_hot(rng.randint(0, 2, 6), 2))
+    it = MovingWindowBaseDataSetIterator(data, 3, 3, batch_size=8)
+    total = it.total_examples()
+    assert total == 6 * 4 * 4  # 4 tiles x 4 rotation variants per image
+    served = sum(b.num_examples() for b in it)
+    assert served == total
+    assert it.input_columns() == 9
+
+
+def test_moving_window_rejects_non_tiling_shapes():
+    import pytest
+
+    data = _ds(n=2, d=16)
+    with pytest.raises(ValueError):
+        moving_window_dataset(data, 3, 3)  # 4x4 doesn't tile into 3x3
+    with pytest.raises(ValueError):
+        moving_window_dataset(_ds(n=2, d=15), 3, 3)  # not square

@@ -151,3 +151,18 @@ def test_bsp_rejects_delta_updates(server):
     import urllib.error
     with pytest.raises(urllib.error.HTTPError):
         w.update_delta(np.ones(4, np.float32))
+
+
+def test_async_rejects_full_vector_updates(async_server):
+    """ADVICE r3: a stray update(kind='vec') in async mode would silently
+    last-writer-win over every concurrently applied delta; it must be
+    rejected, mirroring the bsp delta rejection."""
+    ps, url = async_server
+    w = ParameterServerWorker(url, "w0")
+    w.startup()
+    w.update_delta(np.ones(4, np.float32))
+    import urllib.error
+    with pytest.raises(urllib.error.HTTPError):
+        w.update(5 * np.ones(4, np.float32))  # default kind="vec"
+    # fleet progress untouched by the rejected write
+    np.testing.assert_array_equal(np.asarray(ps.fetch(0)), np.ones(4))
