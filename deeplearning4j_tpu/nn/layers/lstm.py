@@ -65,8 +65,12 @@ class LSTMLayer:
         B, T, _ = x.shape
         n_h = conf.n_out
         n_in = conf.n_in
-        h0 = jnp.zeros((B, n_h), x.dtype)
-        c0 = jnp.zeros((B, n_h), x.dtype)
+        # zeros_like(x, shape=...) so the carry inherits x's varying
+        # manual axes: inside shard_map(check_vma=True) a plain zeros
+        # carry is typed invariant and the scan rejects the dp-varying
+        # output carry
+        h0 = jnp.zeros_like(x, shape=(B, n_h))
+        c0 = jnp.zeros_like(x, shape=(B, n_h))
         xs = jnp.swapaxes(x, 0, 1)  # [time, batch, n_in] for scan
 
         if LSTMLayer._use_fused(conf):
@@ -142,8 +146,9 @@ class GravesLSTMLayer(LSTMLayer):
                                            training)[0]
         B, T, _ = x.shape
         n_h = conf.n_out
-        h0 = jnp.zeros((B, n_h), x.dtype)
-        c0 = jnp.zeros((B, n_h), x.dtype)
+        # carry inherits x's varying manual axes (see LSTMLayer.forward)
+        h0 = jnp.zeros_like(x, shape=(B, n_h))
+        c0 = jnp.zeros_like(x, shape=(B, n_h))
         xs = jnp.swapaxes(x, 0, 1)
 
         def step(carry, x_t):

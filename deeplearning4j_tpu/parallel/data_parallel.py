@@ -132,7 +132,7 @@ def make_dp_train_step(conf: MultiLayerConfiguration, mesh: Mesh,
             return local_step(state, x, y, None, key)
         in_specs = (rep, P(axis), P(axis), rep)
     sharded = jax.shard_map(fn, mesh=mesh, in_specs=in_specs,
-                            out_specs=(rep, rep), check_vma=False)
+                            out_specs=(rep, rep))
     return jax.jit(sharded, donate_argnums=(0,))
 
 
@@ -264,8 +264,14 @@ def make_averaging_round(conf: MultiLayerConfiguration, mesh: Mesh,
                 params = update_bn_ema_from_stats(conf, params, stats)
             return (params, upd, k), score
 
+        # the carry becomes dp-varying after one step (per-shard RNG fold,
+        # masked gates); mark the invariant inits as varying so the
+        # check_vma pass can type the scan with checking ON
+        from deeplearning4j_tpu.parallel.sequence import _as_varying
+        vary = lambda t: jax.tree_util.tree_map(  # noqa: E731
+            lambda a: _as_varying(a, axis), t)
         (params, upd, _), scores = jax.lax.scan(
-            one, (state.params, state.updater, key),
+            one, (vary(state.params), vary(state.updater), key),
             jnp.arange(local_steps))
 
         # the aggregation step: IterateAndUpdateImpl.accumulate -> average
@@ -294,7 +300,7 @@ def make_averaging_round(conf: MultiLayerConfiguration, mesh: Mesh,
             return round_fn(state, x, y, None, key)
         in_specs = (rep, P(axis), P(axis), rep)
     sharded = jax.shard_map(fn, mesh=mesh, in_specs=in_specs,
-                            out_specs=(rep, rep), check_vma=False)
+                            out_specs=(rep, rep))
     return jax.jit(sharded, donate_argnums=(0,))
 
 
