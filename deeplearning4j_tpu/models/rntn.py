@@ -184,13 +184,18 @@ def _forward_one(params, plan):
 
 
 def rntn_loss(params, plans, l2: float = 1e-4):
-    """Mean per-node softmax cross-entropy over a stacked batch of plans."""
+    """Mean per-node softmax cross-entropy over a stacked batch of plans.
+
+    Nodes with label < 0 are UNSUPERVISED (masked out of the loss) — the
+    TreeParser's skip-neutral option for binary sentiment, where a
+    sentiment-free span has no honest class."""
     def one(plan):
         _, logits = _forward_one(params, plan)
         logp = jax.nn.log_softmax(logits, axis=-1)
-        nll = -jnp.take_along_axis(logp, plan["label"][:, None],
+        lbl = jnp.maximum(plan["label"], 0)
+        nll = -jnp.take_along_axis(logp, lbl[:, None],
                                    axis=1).squeeze(-1)
-        w = plan["valid"].astype(logp.dtype)
+        w = (plan["valid"] & (plan["label"] >= 0)).astype(logp.dtype)
         return jnp.sum(nll * w), jnp.sum(w)
 
     tot, cnt = jax.vmap(one)(plans)
@@ -286,10 +291,12 @@ class RNTN:
             root_pred, node_preds = self.predict(t)
             plan = plan_tree(t, self.vocab, self.max_nodes)
             if root_only:
-                correct += int(root_pred == plan.label[plan.n_nodes - 1])
-                total += 1
+                if plan.label[plan.n_nodes - 1] >= 0:  # supervised root
+                    correct += int(root_pred == plan.label[plan.n_nodes - 1])
+                    total += 1
             else:
-                correct += int((node_preds ==
-                                plan.label[:plan.n_nodes]).sum())
-                total += plan.n_nodes
+                lbl = plan.label[:plan.n_nodes]
+                sup = lbl >= 0  # skip unsupervised (masked) nodes
+                correct += int((node_preds[sup] == lbl[sup]).sum())
+                total += int(sup.sum())
         return correct / max(total, 1)

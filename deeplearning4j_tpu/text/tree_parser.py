@@ -24,7 +24,10 @@ attach per-leaf labels.  Passing `lexicon=` (a
 `text/sentiment_lexicon.SentimentLexicon`) instead labels EVERY node from
 the aggregate lexicon polarity of its span — the role SentiWordNet plays
 in the reference's RNTN pipeline, where inner nodes carry phrase-level
-sentiment supervision.
+sentiment supervision.  Two SWN3 behaviors carry over: a span containing
+a negation word has its polarity FLIPPED (SWN3.scoreTokens), and
+sentiment-free spans in binary mode are left UNSUPERVISED (label -1,
+masked by rntn_loss) rather than silently becoming hard negatives.
 """
 
 from __future__ import annotations
@@ -32,6 +35,8 @@ from __future__ import annotations
 from typing import Callable, List, Optional, Sequence, Tuple
 
 from deeplearning4j_tpu.models.rntn import TreeNode
+from deeplearning4j_tpu.text.sentiment_lexicon import (
+    NEGATION_WORDS as _NEGATION_WORDS)
 from deeplearning4j_tpu.text.tokenization import DefaultTokenizerFactory
 
 _NOUN = ("NN", "NNS")
@@ -102,22 +107,29 @@ def _chunk_spans(tags: Sequence[str]) -> List[Tuple[int, int, int, str]]:
 
 class TreeParser:
     def __init__(self, strategy: str = "balanced", n_classes: int = 2,
-                 neutral_label: int = 0,
+                 neutral_label: Optional[int] = None,
                  label_fn: Optional[Callable[[str], int]] = None,
                  lexicon=None, tokenizer_factory=None, tagger=None):
         if strategy not in ("right", "left", "balanced", "chunk"):
             raise ValueError(f"unknown strategy {strategy!r}")
         self.strategy = strategy
         self.n_classes = n_classes
-        self.neutral_label = neutral_label
+        self.neutral_label = 0 if neutral_label is None else neutral_label
         self.lexicon = lexicon
         # span labeling only when the caller did not supply explicit leaf
         # labels — an explicit label_fn always wins (gold supervision)
         self._span_labeling = lexicon is not None and label_fn is None
+        # sentiment-free spans in binary lexicon mode: there is no honest
+        # class, so default to -1 = UNSUPERVISED (rntn_loss masks it);
+        # an explicit neutral_label overrides
+        if neutral_label is not None:
+            self._span_neutral = neutral_label
+        else:
+            self._span_neutral = -1 if n_classes == 2 else 1
         if self._span_labeling:
             # leaves get their final labels in _annotate_spans; neutral here
-            label_fn = lambda tok: neutral_label  # noqa: E731
-        self.label_fn = label_fn or (lambda tok: neutral_label)
+            label_fn = lambda tok: self.neutral_label  # noqa: E731
+        self.label_fn = label_fn or (lambda tok: self.neutral_label)
         self.tokenizer_factory = tokenizer_factory or DefaultTokenizerFactory()
         self._tagger = tagger  # lazily loaded for strategy="chunk"
 
@@ -187,16 +199,32 @@ class TreeParser:
             node = self._merge(left, node, head="right")
         return node
 
-    def _annotate_spans(self, node: TreeNode) -> float:
+    def _annotate_spans(self, node: TreeNode) -> Tuple[float, bool]:
         """Label every node from its span's aggregate lexicon polarity
-        (phrase-level sentiment supervision, the SentiWordNet role)."""
+        (phrase-level sentiment supervision, the SentiWordNet role).
+
+        Negation (SWN3.scoreTokens parity, generalized span-wise): each
+        span's RAW score is the sum of its leaves' extracts; if the span
+        contains any negation word the effective score is flipped — a
+        presence flag, not parity, so 'not good' is negative at every
+        span containing the 'not', exactly once.  Returns (raw score,
+        span-contains-negation).
+
+        Sentiment-free spans (raw score 0 — function-word leaves,
+        neutral phrases) take `neutral_label` instead of defaulting into
+        the binary negative class (the reference's classForScore has an
+        explicit neutral)."""
         if node.is_leaf:
             score = self.lexicon.score(node.word)
+            negated = node.word.lower() in _NEGATION_WORDS
         else:
-            score = (self._annotate_spans(node.left)
-                     + self._annotate_spans(node.right))
-        node.label = self.lexicon.label_for_score(score, self.n_classes)
-        return score
+            ls, ln = self._annotate_spans(node.left)
+            rs, rn = self._annotate_spans(node.right)
+            score, negated = ls + rs, ln or rn
+        eff = -score if negated else score
+        node.label = self.lexicon.label_for_score(
+            eff, self.n_classes, neutral=self._span_neutral)
+        return score, negated
 
     # -- public API (TreeParser.getTrees analog)
     def parse(self, sentence: str) -> Optional[TreeNode]:

@@ -59,11 +59,17 @@ def test_bundled_lexicon_file_is_swn3_format():
     with open(sl._BUNDLED) as f:
         data_lines = [l for l in f if l.strip() and not l.startswith("#")]
     parts = data_lines[0].rstrip("\n").split("\t")
-    assert len(parts) == 5  # POS  ID  PosScore  NegScore  SynsetTerms
+    # POS ID PosScore NegScore SynsetTerms [Gloss] — the standard
+    # SentiWordNet 3.x layout has a 6th gloss column; the bundled file
+    # omits it, and the parser accepts either
+    assert len(parts) >= 5
     float(parts[2]), float(parts[3])
 
 
 def test_sentiwordnet_file_parsing(tmp_path):
+    """SWN3.java:64-126 aggregation: per `word#POS` key the synset scores
+    land at their sense rank and are harmonically weighted
+    (sum_i v[i]/(i+1) / sum_{i=1..n} 1/i); extract() sums across POS."""
     p = tmp_path / "swn.txt"
     p.write_text(
         "# SentiWordNet comment\n"
@@ -71,9 +77,42 @@ def test_sentiwordnet_file_parsing(tmp_path):
         "a\t00002098\t0\t0.875\tbad#1\n"
         "a\t00002312\t0.25\t0.125\tgood#3\n")
     lex = SentimentLexicon.from_sentiwordnet(str(p))
-    assert abs(lex.score("good") - (0.75 + 0.125) / 2) < 1e-9
+    # good#a senses: rank1=0.75, rank2 absent (0), rank3=0.125
+    want_good = (0.75 / 1 + 0.0 / 2 + 0.125 / 3) / (1 + 1 / 2 + 1 / 3)
+    assert abs(lex.score("good") - want_good) < 1e-9
     assert lex.score("bad") == -0.875
-    assert lex.score("great") == 0.75
+    # great#a rank2 only: vector [0, 0.75] -> (0.75/2) / (1 + 1/2)
+    assert abs(lex.score("great") - (0.75 / 2) / 1.5) < 1e-9
+
+
+def test_sentiment_negation_flip():
+    """SWN3.scoreTokens parity: a negation word flips the span score."""
+    lex = SentimentLexicon()
+    pos = lex.score_tokens(["a", "good", "movie"])
+    neg = lex.score_tokens(["not", "a", "good", "movie"])
+    assert pos > 0 and abs(neg + pos) < 1e-9
+
+
+def test_sentiment_malformed_rank_skipped(tmp_path):
+    """A non-positive sense rank (foo#0) is skipped like other malformed
+    fields instead of crashing the lexicon load."""
+    p = tmp_path / "bad.txt"
+    p.write_text("a\t1\t0.5\t0\tfoo#0 bar#1\n")
+    lex = SentimentLexicon.from_sentiwordnet(str(p))
+    assert lex.score("bar") == 0.5 and lex.score("foo") == 0.0
+
+
+def test_neutral_sentinel_honored_in_three_class_mode():
+    assert SentimentLexicon.label_for_score(0.0, 3, neutral=-1) == -1
+    assert SentimentLexicon.label_for_score(0.05, 3) == 1  # band neutral
+
+
+def test_sentiment_multisense_gloss_column(tmp_path):
+    """Standard 6-column SentiWordNet rows (trailing gloss) parse too."""
+    p = tmp_path / "swn6.txt"
+    p.write_text("a\t1\t0.5\t0\thappy#1\tenjoying well-being\n")
+    lex = SentimentLexicon.from_sentiwordnet(str(p))
+    assert lex.score("happy") == 0.5
 
 
 def test_lexicon_labels_trees_for_rntn():

@@ -124,6 +124,56 @@ def test_lexicon_span_labels_compose():
         assert t.label == 0, strategy
 
 
+def test_lexicon_negation_flips_span_labels():
+    """ADVICE r4 #1: SWN3.scoreTokens flips polarity on negation words —
+    'the movie was not good' must NOT get positive labels."""
+    from deeplearning4j_tpu.text.sentiment_lexicon import SentimentLexicon
+
+    lex = SentimentLexicon()
+    parser = TreeParser("balanced", lexicon=lex)
+    pos_root = parser.parse("the movie was good")
+    neg_root = parser.parse("the movie was not good")
+    assert pos_root.label == 1
+    assert neg_root.label == 0
+
+
+def test_lexicon_neutral_spans_unsupervised_in_binary():
+    """ADVICE r4 #3: sentiment-free spans (function words, neutral
+    phrases) are unsupervised (-1, masked by rntn_loss) in binary mode
+    instead of defaulting to the negative class; an explicit
+    neutral_label overrides."""
+    from deeplearning4j_tpu.text.sentiment_lexicon import SentimentLexicon
+
+    lex = SentimentLexicon()
+    t = TreeParser("balanced", lexicon=lex).parse("the of and")
+    assert t.label == -1 and t.left.label == -1
+    t2 = TreeParser("balanced", lexicon=lex, neutral_label=0).parse(
+        "the of and")
+    assert t2.label == 0
+
+
+def test_rntn_masks_unsupervised_nodes():
+    """label=-1 nodes contribute nothing to the loss or accuracy."""
+    import numpy as np
+
+    from deeplearning4j_tpu.models.rntn import (
+        RNTN, TreeNode, plan_tree, rntn_loss, stack_plans)
+
+    leaf_a = TreeNode(label=1, word="good")
+    leaf_b = TreeNode(label=-1, word="the")
+    t = TreeNode(label=1, left=leaf_b, right=leaf_a)
+    model = RNTN(dim=4, n_classes=2, max_nodes=8, seed=0)
+    model.fit([t], epochs=1)
+    plans = stack_plans([plan_tree(t, model.vocab, 8)])
+    loss = rntn_loss(model.params, plans)
+    assert np.isfinite(float(loss))
+    # all-unsupervised tree: loss is 0 (no labeled node)
+    t0 = TreeNode(label=-1, left=TreeNode(label=-1, word="a"),
+                  right=TreeNode(label=-1, word="b"))
+    plans0 = stack_plans([plan_tree(t0, model.vocab, 8)])
+    assert float(rntn_loss(model.params, plans0, l2=0.0)) == 0.0
+
+
 def test_rntn_sentiment_on_chunked_trees():
     """RNTN sentiment evaluation on chunk vs balanced trees (VERDICT r3
     next-#6): both converge on an in-vocabulary labeled set; the chunk
