@@ -245,14 +245,25 @@ class Word2Vec:
     # -- training ----------------------------------------------------------
     def fit(self, sentences=None) -> "Word2Vec":
         sentences = sentences if sentences is not None else self.sentences
-        token_lists = [self.tokenize(s) if isinstance(s, str) else list(s)
-                       for s in sentences]
+        # two passes over the corpus (vocab count, then id conversion)
+        # WITHOUT materializing token text: a re-iterable corpus — list,
+        # or a DiskInvertedIndex.docs() view streaming off disk — is
+        # walked twice, holding int32 id arrays only (the
+        # LuceneInvertedIndex role: corpora >> RAM feed mini-batching).
+        # A one-shot iterator is materialized for compatibility.
+        if iter(sentences) is iter(sentences):
+            sentences = list(sentences)
+
+        def token_lists():
+            for s in sentences:
+                yield self.tokenize(s) if isinstance(s, str) else list(s)
+
         if self.cache is None:
-            self.build_vocab(token_lists)
+            self.build_vocab(token_lists())
         ids_per_sentence = [
             np.asarray([self.cache.index_of(t) for t in toks
                         if t in self.cache], np.int32)
-            for toks in token_lists]
+            for toks in token_lists()]
 
         codes_all, points_all, mask_all = Huffman.padded_arrays(self.cache)
         if not self.use_hs:

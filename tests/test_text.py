@@ -80,6 +80,133 @@ def test_inverted_index():
     assert idx.label(0) == "pet"
 
 
+def test_disk_inverted_index_roundtrip(tmp_path):
+    """DiskInvertedIndex (VERDICT r4 missing #3, LuceneInvertedIndex
+    role): same query contract as the in-memory index, documents on
+    disk, manifest reopen, and log-scan recovery without a manifest."""
+    from deeplearning4j_tpu.text.inverted_index import DiskInvertedIndex
+
+    d = str(tmp_path / "idx")
+    idx = DiskInvertedIndex(d)
+    idx.add_doc(["the", "cat"], label="pet")
+    idx.add_doc(["the", "dog"])
+    assert idx.num_documents() == 2
+    assert idx.doc_frequency("the") == 2
+    assert idx.documents_containing("cat") == [0]
+    assert idx.document(1) == ["the", "dog"]
+    assert idx.label(0) == "pet" and idx.label(1) is None
+    assert list(idx.all_docs()) == [["the", "cat"], ["the", "dog"]]
+    idx.save()
+    idx.close()
+
+    # manifest reopen
+    idx2 = DiskInvertedIndex.load(d)
+    assert idx2.num_documents() == 2
+    assert idx2.document(0) == ["the", "cat"]
+    assert idx2.documents_containing("dog") == [1]
+    # appending after reopen keeps offsets consistent
+    idx2.add_doc(["a", "cat", "again"])
+    assert idx2.document(2) == ["a", "cat", "again"]
+    assert idx2.documents_containing("cat") == [0, 2]
+    idx2.close()
+
+    # no manifest: rebuild by scanning the log
+    import os
+
+    os.remove(os.path.join(d, "index.json"))
+    idx3 = DiskInvertedIndex(d)
+    assert idx3.num_documents() == 3
+    assert idx3.documents_containing("cat") == [0, 2]
+    idx3.close()
+
+
+def test_disk_index_stale_manifest_recovers(tmp_path):
+    """Docs appended AFTER the last save() must survive a reopen: the
+    manifest records the log size it covers, and a mismatch triggers a
+    full log rebuild instead of silently dropping the tail."""
+    from deeplearning4j_tpu.text.inverted_index import DiskInvertedIndex
+
+    d = str(tmp_path / "stale")
+    idx = DiskInvertedIndex(d)
+    idx.add_doc(["a"])
+    idx.save()
+    idx.add_doc(["b"])  # durable in the log, NOT in the manifest
+    idx._flush()
+    idx.close()
+
+    idx2 = DiskInvertedIndex(d)
+    assert idx2.num_documents() == 2
+    assert idx2.documents_containing("b") == [1]
+    assert idx2.add_doc(["c"]) == 2
+    assert idx2.document(2) == ["c"]
+    idx2.close()
+
+
+def test_in_memory_index_to_disk(tmp_path):
+    from deeplearning4j_tpu.text.inverted_index import DiskInvertedIndex
+
+    mem = InvertedIndex()
+    mem.add_doc(["x", "y"], label="l")
+    mem.add_doc(["y", "z"])
+    disk = mem.to_disk(str(tmp_path / "d"))
+    assert disk.num_documents() == 2
+    assert disk.document(0) == ["x", "y"] and disk.label(0) == "l"
+    assert disk.documents_containing("y") == [0, 1]
+    disk.close()
+
+
+def test_disk_index_streams_with_bounded_ram(tmp_path):
+    """The point of the disk store: iterating the corpus must not pull
+    it into RAM.  Python-allocation peak while streaming stays far below
+    the on-disk corpus size."""
+    import os
+    import tracemalloc
+
+    from deeplearning4j_tpu.text.inverted_index import DiskInvertedIndex
+
+    d = str(tmp_path / "big")
+    idx = DiskInvertedIndex(d)
+    for i in range(4000):
+        idx.add_doc([f"w{(i * 7 + j) % 997}" for j in range(40)])
+    idx.save()
+    idx.close()
+    corpus_bytes = os.path.getsize(os.path.join(d, "docs.jsonl"))
+    assert corpus_bytes > 1_000_000
+
+    idx = DiskInvertedIndex(d)
+    tracemalloc.start()
+    base, _ = tracemalloc.get_traced_memory()
+    n = tot = 0
+    for doc in idx.all_docs():
+        n += 1
+        tot += len(doc)
+    _, peak = tracemalloc.get_traced_memory()
+    tracemalloc.stop()
+    idx.close()
+    assert n == 4000 and tot == 160_000
+    assert peak - base < corpus_bytes / 10
+
+
+def test_word2vec_trains_from_disk_index(tmp_path):
+    """End of VERDICT r4 next-#5: w2v trains from a corpus streamed off
+    disk (re-iterable DiskDocs view; fit holds int32 ids, not text)."""
+    import numpy as np
+
+    from deeplearning4j_tpu.models.word2vec import Word2Vec
+    from deeplearning4j_tpu.text.inverted_index import DiskInvertedIndex
+
+    rng = np.random.RandomState(3)
+    idx = DiskInvertedIndex(str(tmp_path / "w2v"))
+    for _ in range(60):
+        idx.add_doc([f"tok{rng.randint(30)}" for _ in range(12)])
+    w2v = Word2Vec(vector_length=16, window=3, negative=3,
+                   min_word_frequency=1, epochs=1, seed=0, batch_size=64)
+    w2v.fit(idx.docs())
+    assert w2v.cache.num_words() >= 30
+    assert np.isfinite(np.asarray(w2v.table.syn0)).all()
+    idx.close()
+
+
 def test_bow_and_tfidf():
     docs = ["cat sat mat", "dog sat log", "cat cat dog"]
     bow = BagOfWordsVectorizer(min_word_frequency=1).fit(docs)
