@@ -18,8 +18,17 @@ from deeplearning4j_tpu.clustering.kdtree import KDTree
 from deeplearning4j_tpu.clustering.vptree import VPTree
 from deeplearning4j_tpu.clustering.quadtree import QuadTree
 from deeplearning4j_tpu.clustering.sptree import SpTree
+from deeplearning4j_tpu.clustering.strategy import (
+    BaseClusteringAlgorithm, ClusteringOptimizationType,
+    ConvergenceCondition, FixedClusterCountStrategy,
+    FixedIterationCountCondition, IterationHistory, OptimisationStrategy,
+    VarianceVariationCondition)
 
 __all__ = [
     "Cluster", "ClusterSet", "Point", "KMeansClustering", "KDTree",
-    "VPTree", "QuadTree", "SpTree",
+    "VPTree", "QuadTree", "SpTree", "BaseClusteringAlgorithm",
+    "ClusteringOptimizationType", "ConvergenceCondition",
+    "FixedClusterCountStrategy", "FixedIterationCountCondition",
+    "IterationHistory", "OptimisationStrategy",
+    "VarianceVariationCondition",
 ]

@@ -59,7 +59,12 @@ def _lloyd(x, init_centers, max_iters: int, tol: float):
 
 
 class KMeansClustering:
-    """`KMeansClustering.setup(k, maxIters, distanceFn)` parity facade."""
+    """`KMeansClustering.setup(k, maxIters, distanceFn)` parity facade.
+
+    This class is the fast fixed-shape path (whole Lloyd loop in one
+    jit).  The reference's two `setup` overloads return the pluggable
+    `BaseClusteringAlgorithm` (strategy framework, empty-cluster repair,
+    optimisation phase) from `clustering/strategy.py`."""
 
     def __init__(self, k: int, max_iterations: int = 100, tol: float = 1e-4,
                  seed: int = 0):
@@ -67,6 +72,26 @@ class KMeansClustering:
         self.max_iterations = max_iterations
         self.tol = tol
         self.seed = seed
+
+    @staticmethod
+    def setup(k: int, max_iterations: int = None,
+              distance_fn: str = "euclidean",
+              min_distribution_variation_rate: float = None,
+              allow_empty_clusters: bool = False, seed: int = 0):
+        """`KMeansClustering.setup` parity (both Java overloads): returns
+        a strategy-driven `BaseClusteringAlgorithm` terminating either on
+        iteration count or on distribution-variation convergence."""
+        from deeplearning4j_tpu.clustering.strategy import (
+            BaseClusteringAlgorithm, FixedClusterCountStrategy)
+
+        strat = FixedClusterCountStrategy.setup(k, distance_fn,
+                                                allow_empty_clusters)
+        if min_distribution_variation_rate is not None:
+            strat.end_when_distribution_variation_rate_less_than(
+                min_distribution_variation_rate)
+        else:
+            strat.end_when_iteration_count_equals(max_iterations or 100)
+        return BaseClusteringAlgorithm.setup(strat, seed=seed)
 
     def _kmeanspp_seed(self, x: np.ndarray,
                        rng: np.random.RandomState) -> np.ndarray:
