@@ -8,7 +8,15 @@ Parity: reference `ui/UiServer.java` + resources:
   GET  /api/nearest?word=W&k=K  nearest neighbors by label        (NearestNeighborsResource)
   POST /api/weights           upload a param pytree's histograms  (WeightResource)
   GET  /api/weights           fetch histogram summaries
+  GET  /api/renders           list rendered images in renders_dir (RendersResource)
+  GET  /api/renders/NAME      fetch one rendered image (png)
+  GET  /render                HTML gallery of the rendered images (RenderView)
   GET  /                      scatter-plot HTML view              (FreeMarker tsne.ftl)
+
+The renders endpoints expose what `plot/plotter.py` (`NeuralNetPlotter`,
+`FilterRenderer`, `PlotIterationListener`) writes into its out_dir —
+the reference serves the same artifacts through
+`ui/renders/RendersResource.java` + `RenderView`.
 """
 
 from __future__ import annotations
@@ -53,6 +61,7 @@ class _UiState:
         self.classes: List[int] = []
         self.weights: Dict[str, dict] = {}
         self.vptree = None
+        self.renders_dir: Optional[str] = None
         self.lock = threading.Lock()
 
     def rebuild_tree(self):
@@ -78,6 +87,16 @@ class _Handler(BaseHTTPRequestHandler):
         n = int(self.headers.get("Content-Length", 0))
         return json.loads(self.rfile.read(n) or b"{}")
 
+    def _render_names(self) -> List[str]:
+        import os
+
+        d = self.state.renders_dir
+        if not d or not os.path.isdir(d):
+            return []
+        return sorted(f for f in os.listdir(d)
+                      if f.rsplit(".", 1)[-1].lower()
+                      in ("png", "jpg", "jpeg", "svg"))
+
     def do_GET(self):  # noqa: N802
         u = urlparse(self.path)
         st = self.state
@@ -94,6 +113,28 @@ class _Handler(BaseHTTPRequestHandler):
         elif u.path == "/api/weights":
             with st.lock:
                 self._send(st.weights)
+        elif u.path == "/api/renders":
+            self._send({"images": self._render_names()})
+        elif u.path.startswith("/api/renders/"):
+            import os
+
+            name = os.path.basename(u.path[len("/api/renders/"):])
+            if st.renders_dir is None or name not in self._render_names():
+                self._send({"error": f"unknown render {name!r}"}, 404)
+                return
+            with open(os.path.join(st.renders_dir, name), "rb") as f:
+                data = f.read()
+            ext = name.rsplit(".", 1)[-1].lower()
+            sub = {"jpg": "jpeg", "svg": "svg+xml"}.get(ext, ext)
+            self._send(data, ctype=f"image/{sub}")
+        elif u.path == "/render":
+            imgs = "\n".join(
+                f'<figure><img src="/api/renders/{n}" style="max-width:45%">'
+                f"<figcaption>{n}</figcaption></figure>"
+                for n in self._render_names())
+            self._send((f"<!doctype html><html><head><title>renders</title>"
+                        f"</head><body><h2>Renders</h2>{imgs}</body></html>")
+                       .encode(), ctype="text/html")
         elif u.path == "/api/nearest":
             q = parse_qs(u.query)
             word = q.get("word", [""])[0]
@@ -164,8 +205,10 @@ class _Handler(BaseHTTPRequestHandler):
 class UiServer:
     """`UiServer.main()` parity: start/stop an embedded UI server."""
 
-    def __init__(self, port: int = 0, host: str = "127.0.0.1"):
+    def __init__(self, port: int = 0, host: str = "127.0.0.1",
+                 renders_dir: Optional[str] = None):
         self.state = _UiState()
+        self.state.renders_dir = renders_dir
         handler = type("Handler", (_Handler,), {"state": self.state})
         self.server = ThreadingHTTPServer((host, port), handler)
         self.port = self.server.server_address[1]
