@@ -41,6 +41,60 @@ def test_provisioner_dry_run_generates_commands():
     assert "JAX_COORDINATOR_ADDRESS=10.0.0.1:9000" in sshes[1][-1]
 
 
+def test_ssh_launcher_commands_include_workdir_cd():
+    from deeplearning4j_tpu.scaleout.provision import SshLauncher
+
+    prov = HostProvisioner(_spec(), launcher=SshLauncher(dry_run=True))
+    prov.launch_workers("python worker.py")
+    sshes = [c for c in prov.executed if c[0] == "ssh"]
+    assert len(sshes) == 2
+    assert sshes[0][-1].startswith("cd /opt/dl4j_tpu && ")
+
+
+def test_local_launcher_runs_real_fleet(tmp_path):
+    """VERDICT r4 next-#8: the SAME ClusterSpec drives a real fleet via
+    the pluggable launcher; here the second host is stood in by local
+    subprocesses.  Each worker writes its jax.distributed env + cwd to a
+    shared file — proving per-host env wiring AND per-host sandboxes."""
+    import json
+
+    from deeplearning4j_tpu.scaleout.provision import LocalLauncher
+
+    launcher = LocalLauncher(str(tmp_path / "fleet"))
+    prov = HostProvisioner(_spec(), launcher=launcher)
+
+    # provision: pushed artifact lands in each host's sandbox workdir
+    src = tmp_path / "pkg"
+    src.mkdir()
+    (src / "marker.txt").write_text("v1")
+    prov.provision_all(str(src))
+
+    out = tmp_path / "out.jsonl"
+    entry = (f"python -c \"import os, json; "
+             f"open({str(out)!r}, 'a').write(json.dumps("
+             f"{{'pid': os.environ['JAX_PROCESS_ID'], "
+             f"'n': os.environ['JAX_NUM_PROCESSES'], "
+             f"'coord': os.environ['JAX_COORDINATOR_ADDRESS'], "
+             f"'cwd': os.getcwd()}}) + chr(10))\"")
+    prov.launch_workers(entry)
+    rcs = prov.wait(timeout=60)
+    assert rcs == [0, 0]
+
+    rows = [json.loads(l) for l in out.read_text().splitlines()]
+    assert {r["pid"] for r in rows} == {"0", "1"}
+    assert all(r["n"] == "2" for r in rows)
+    assert all(r["coord"] == "10.0.0.1:9000" for r in rows)
+    # two distinct per-host sandboxes, both under the fleet dir
+    cwds = {r["cwd"] for r in rows}
+    assert len(cwds) == 2
+    assert all("fleet" in c and c.endswith("opt/dl4j_tpu") for c in cwds)
+    # provisioning landed the artifact in each sandbox
+    for host in _spec().hosts:
+        d = launcher.host_dir(host)
+        assert os.path.isfile(
+            os.path.join(d, "opt/dl4j_tpu/pkg/marker.txt"))
+
+
 def test_local_blob_store_roundtrip(tmp_path):
     store = LocalBlobStore(str(tmp_path / "store"))
     src = tmp_path / "a.txt"
