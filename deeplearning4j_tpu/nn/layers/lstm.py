@@ -38,6 +38,12 @@ class LSTMLayer:
     def _step(params, n_h, carry, x_t):
         h, c = carry
         z = jnp.concatenate([x_t, h], axis=-1) @ params["W"] + params["b"]
+        return LSTMLayer._gates(n_h, carry, z)
+
+    @staticmethod
+    def _gates(n_h, carry, z):
+        """Gate math given the pre-activation z = xW_x + hW_h + b."""
+        h, c = carry
         i = jax.nn.sigmoid(z[..., :n_h])
         f = jax.nn.sigmoid(z[..., n_h:2 * n_h])
         o = jax.nn.sigmoid(z[..., 2 * n_h:3 * n_h])
@@ -71,7 +77,6 @@ class LSTMLayer:
         # output carry
         h0 = jnp.zeros_like(x, shape=(B, n_h))
         c0 = jnp.zeros_like(x, shape=(B, n_h))
-        xs = jnp.swapaxes(x, 0, 1)  # [time, batch, n_in] for scan
 
         if LSTMLayer._use_fused(conf):
             # Pallas cell: one kernel per step (both matmuls + gates +
@@ -84,11 +89,30 @@ class LSTMLayer:
                 h, c = carry
                 h, c = fused_lstm_step(x_t, h, c, wx, wh, params["b"])
                 return (h, c), h
-        else:
-            def step(carry, x_t):
-                return LSTMLayer._step(params, n_h, carry, x_t)
 
-        (_, _), hs = jax.lax.scan(step, (h0, c0), xs)
+            (_, _), hs = jax.lax.scan(step, (h0, c0),
+                                      jnp.swapaxes(x, 0, 1))
+            return jnp.swapaxes(hs, 0, 1)
+
+        return LSTMLayer._hoisted_scan(
+            params, n_in, x, h0, c0,
+            lambda carry, z: LSTMLayer._gates(n_h, carry, z))
+
+    @staticmethod
+    def _hoisted_scan(params, n_in, x, h0, c0, gates):
+        """Scan path shared by LSTM/GravesLSTM: hoist the input half of
+        the fused gate matmul out of the loop — ONE [B*T, n_in]@[n_in, 4H]
+        MXU matmul up front (plus the bias), leaving only the small
+        recurrent h@W_h per step.  Identical math to concat([x,h])@W,
+        reassociated.  `gates`: (carry, z) -> ((h, c), h)."""
+        wh = params["W"][n_in:]
+        z_x = x @ params["W"][:n_in] + params["b"]  # [B, T, 4H]
+
+        def step(carry, zx_t):
+            h, _ = carry
+            return gates(carry, zx_t + h @ wh)
+
+        (_, _), hs = jax.lax.scan(step, (h0, c0), jnp.swapaxes(z_x, 0, 1))
         return jnp.swapaxes(hs, 0, 1)
 
     @staticmethod
@@ -127,6 +151,11 @@ class GravesLSTMLayer(LSTMLayer):
     def _step(params, n_h, carry, x_t):
         h, c = carry
         z = jnp.concatenate([x_t, h], axis=-1) @ params["W"] + params["b"]
+        return GravesLSTMLayer._gates(params, n_h, carry, z)
+
+    @staticmethod
+    def _gates(params, n_h, carry, z):
+        h, c = carry
         i = jax.nn.sigmoid(z[..., :n_h] + params["p_i"] * c)
         f = jax.nn.sigmoid(z[..., n_h:2 * n_h] + params["p_f"] * c)
         g = jnp.tanh(z[..., 3 * n_h:])
@@ -149,13 +178,9 @@ class GravesLSTMLayer(LSTMLayer):
         # carry inherits x's varying manual axes (see LSTMLayer.forward)
         h0 = jnp.zeros_like(x, shape=(B, n_h))
         c0 = jnp.zeros_like(x, shape=(B, n_h))
-        xs = jnp.swapaxes(x, 0, 1)
-
-        def step(carry, x_t):
-            return GravesLSTMLayer._step(params, n_h, carry, x_t)
-
-        (_, _), hs = jax.lax.scan(step, (h0, c0), xs)
-        return jnp.swapaxes(hs, 0, 1)
+        return LSTMLayer._hoisted_scan(
+            params, conf.n_in, x, h0, c0,
+            lambda carry, z: GravesLSTMLayer._gates(params, n_h, carry, z))
 
     @staticmethod
     def step(params, conf, x_t, h, c):

@@ -209,6 +209,26 @@ def test_remat_matches_no_remat_loss_and_grads():
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 
 
+def test_lstm_hoisted_scan_matches_stepwise():
+    """The scan path hoists the input gate projection out of the loop
+    (x@Wx once, h@Wh per step); it must match the naive per-step
+    concat([x,h])@W recurrence to fp tolerance."""
+    conf = NeuralNetConfiguration(layer_type=LayerType.LSTM, n_in=6, n_out=5,
+                                  lstm_impl="scan")
+    p = LSTMLayer.init(KEY, conf)
+    x = jax.random.normal(jax.random.PRNGKey(1), (3, 9, 6))
+    out = LSTMLayer.forward(p, conf, x)
+
+    h = jnp.zeros((3, 5))
+    c = jnp.zeros((3, 5))
+    naive = []
+    for t in range(9):
+        (h, c), _ = LSTMLayer._step(p, 5, (h, c), x[:, t, :])
+        naive.append(h)
+    naive = jnp.stack(naive, axis=1)
+    assert jnp.allclose(out, naive, atol=1e-5)
+
+
 def test_graves_lstm_peepholes_train_and_differ():
     """GRAVES_LSTM = LSTM + peephole connections (VERDICT r2 weak #7): at
     zero-init it matches the plain LSTM exactly; training moves the
