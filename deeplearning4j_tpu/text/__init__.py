@@ -11,8 +11,8 @@ Parity with the reference's `deeplearning4j-nlp` text layer:
 """
 
 from deeplearning4j_tpu.text.sentence_iterator import (
-    CollectionSentenceIterator, FileSentenceIterator, LineSentenceIterator,
-    LabelAwareSentenceIterator)
+    CollectionSentenceIterator, FileSentenceIterator,
+    IndexSentenceIterator, LineSentenceIterator, LabelAwareSentenceIterator)
 from deeplearning4j_tpu.text.tokenization import (DefaultTokenizer,
                                                   DefaultTokenizerFactory,
                                                   input_homogenization)

@@ -135,6 +135,32 @@ class LabelAwareSentenceIterator(CollectionSentenceIterator):
         return self.labels[max(0, self._i - 1)]
 
 
+class IndexSentenceIterator(BaseSentenceIterator):
+    """Sentences streamed from an inverted-index corpus store — the
+    `LuceneSentenceIterator.java` analog: the reference iterates the
+    sentences Lucene has on disk; here the store is `InvertedIndex` or
+    the disk-backed `DiskInvertedIndex` (bounded-RAM streaming), with
+    documents detokenized by `sep`."""
+
+    def __init__(self, index, preprocessor=None, sep: str = " "):
+        super().__init__(preprocessor)
+        self.index = index
+        self.sep = sep
+        self.reset()
+
+    def reset(self) -> None:
+        self._it = iter(self.index.all_docs())
+        self._next = next(self._it, None)
+
+    def has_next(self) -> bool:
+        return self._next is not None
+
+    def next_sentence(self) -> str:
+        toks = self._next
+        self._next = next(self._it, None)
+        return self._prep(self.sep.join(toks))
+
+
 class DocumentIterator:
     """Whole-document iterator (`DocumentIterator.java`): each item is the
     full text of one file under root."""

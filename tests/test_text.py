@@ -187,6 +187,25 @@ def test_disk_index_streams_with_bounded_ram(tmp_path):
     assert peak - base < corpus_bytes / 10
 
 
+def test_index_sentence_iterator(tmp_path):
+    """`LuceneSentenceIterator` analog: sentences streamed from the
+    corpus store (in-memory or disk), preprocessor applied, resettable."""
+    from deeplearning4j_tpu.text import IndexSentenceIterator
+
+    mem = InvertedIndex()
+    mem.add_doc(["Hello", "world"])
+    mem.add_doc(["second", "doc"])
+    it = IndexSentenceIterator(mem, preprocessor=str.lower)
+    assert list(it) == ["hello world", "second doc"]
+    assert list(it) == ["hello world", "second doc"]  # reset works
+
+    disk = mem.to_disk(str(tmp_path / "idx"))
+    it2 = IndexSentenceIterator(disk)
+    assert it2.has_next() and it2.next_sentence() == "Hello world"
+    assert it2.next_sentence() == "second doc" and not it2.has_next()
+    disk.close()
+
+
 def test_word2vec_trains_from_disk_index(tmp_path):
     """End of VERDICT r4 next-#5: w2v trains from a corpus streamed off
     disk (re-iterable DiskDocs view; fit holds int32 ids, not text)."""
