@@ -1,19 +1,79 @@
 """Moving-window featurization.
 
-Parity: reference `text/movingwindow/{Windows,WindowConverter,WordConverter}`
-— fixed-size word windows with <s>/</s> padding, converted to stacked
-word-vector features for window-classification models (the viterbi-decoded
-sequence labelers), and `util/MovingWindowMatrix`.
+Parity: reference `text/movingwindow/{Windows,WindowConverter,WordConverter,
+ContextLabelRetriever}` — fixed-size word windows with <s>/</s> padding,
+converted to stacked word-vector features for window-classification models
+(the viterbi-decoded sequence labelers), and `util/MovingWindowMatrix`.
 """
 
 from __future__ import annotations
 
-from typing import List, Sequence
+import re
+from typing import List, Sequence, Tuple
 
 import numpy as np
 
 BEGIN = "<s>"
 END = "</s>"
+
+_BEGIN_LABEL = re.compile(r"^<([A-Za-z]+|\d+)>$")
+_END_LABEL = re.compile(r"^</([A-Za-z]+|\d+)>$")
+
+
+def string_with_labels(sentence: str, tokenizer_factory=None
+                       ) -> Tuple[str, List[Tuple[str, List[str]]]]:
+    """`ContextLabelRetriever.stringWithLabels` parity: parse inline
+    `<LABEL> tokens </LABEL>` markup into (stripped sentence, list of
+    (label, tokens) spans); unlabeled runs carry the label "NONE".
+    Raises ValueError on unbalanced or mismatched label tags.
+
+    The markup is matched on raw whitespace tokens BEFORE the factory's
+    tokenizer runs, so a punctuation-stripping preprocessor (e.g.
+    `input_homogenization`, which would erase the <>/ tag characters and
+    silently leak 'per john per' into the text) cannot corrupt the
+    parse; only the span contents go through the tokenizer."""
+    if tokenizer_factory is None:
+        from deeplearning4j_tpu.text.tokenization import (
+            DefaultTokenizerFactory)
+
+        tokenizer_factory = DefaultTokenizerFactory()
+    def tokenize(run: List[str]) -> List[str]:
+        return tokenizer_factory.create(" ".join(run)).get_tokens()
+
+    spans: List[Tuple[str, List[str]]] = []
+    curr: List[str] = []
+    curr_label = None
+
+    def close_run(label: str) -> None:
+        toks = tokenize(curr)
+        if toks:
+            spans.append((label, toks))
+        curr.clear()
+
+    for token in sentence.split():
+        begin = _BEGIN_LABEL.match(token)
+        end = _END_LABEL.match(token)
+        if begin:
+            if curr_label is not None:
+                raise ValueError(
+                    f"nested begin label {token!r} inside {curr_label!r}")
+            close_run("NONE")  # unlabeled run before this label
+            curr_label = begin.group(1)
+        elif end:
+            if curr_label is None:
+                raise ValueError(f"end label {token!r} with no begin label")
+            if end.group(1) != curr_label:
+                raise ValueError(f"label mismatch: <{curr_label}> ended "
+                                 f"by {token!r}")
+            close_run(curr_label)
+            curr_label = None
+        else:
+            curr.append(token)
+    if curr_label is not None:
+        raise ValueError(f"unclosed label <{curr_label}>")
+    close_run("NONE")
+    stripped = " ".join(t for _, toks in spans for t in toks)
+    return stripped, spans
 
 
 class Window:

@@ -23,6 +23,35 @@ def fingerprint(s: str) -> str:
     return " ".join(sorted(set(s.split())))
 
 
+class StringCluster(dict):
+    """`util/StringCluster.java` parity: {fingerprint -> {variant ->
+    count}} over a list of strings, with clusters ordered by size and a
+    canonical (most frequent) variant per cluster."""
+
+    def __init__(self, strings: List[str] = ()):
+        super().__init__()
+        for s in strings:
+            self.add(s)
+
+    def add(self, s: str) -> None:
+        m = self.setdefault(fingerprint(s), {})
+        m[s] = m.get(s, 0) + 1
+
+    def clusters(self) -> List[Dict[str, int]]:
+        """Variant maps (copies — mutating them cannot corrupt this
+        cluster), largest cluster first (StringCluster.getClusters +
+        sort)."""
+        return [dict(m) for m in
+                sorted(self.values(), key=lambda m: -sum(m.values()))]
+
+    def canonical(self, s: str) -> str:
+        """The most frequent variant in s's cluster (ties: lexical)."""
+        m = self.get(fingerprint(s))
+        if not m:
+            return s
+        return max(sorted(m), key=lambda v: m[v])
+
+
 class StringGrid:
     """A list of string rows with fingerprint clustering on a column."""
 

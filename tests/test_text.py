@@ -248,6 +248,28 @@ def test_bow_and_tfidf():
     assert ds.labels.shape == (1, 2)
 
 
+def test_context_label_retriever():
+    """ContextLabelRetriever parity: inline <LABEL> spans stripped into
+    (label, tokens), unlabeled runs labeled NONE, malformed markup
+    rejected."""
+    import pytest
+
+    from deeplearning4j_tpu.text.windows import string_with_labels
+
+    stripped, spans = string_with_labels(
+        "the <PER> john smith </PER> went to <LOC> paris </LOC> today")
+    assert stripped == "the john smith went to paris today"
+    assert spans == [("NONE", ["the"]), ("PER", ["john", "smith"]),
+                     ("NONE", ["went", "to"]), ("LOC", ["paris"]),
+                     ("NONE", ["today"])]
+    with pytest.raises(ValueError):
+        string_with_labels("<A> x </B>")
+    with pytest.raises(ValueError):
+        string_with_labels("x </A>")
+    with pytest.raises(ValueError):
+        string_with_labels("<A> x")
+
+
 def test_windows():
     ws = windows(["a", "b", "c"], window_size=3)
     assert len(ws) == 3

@@ -132,6 +132,18 @@ class TestStringGrid:
         assert sorted(map(len, clusters.values())) == [1, 2]
         assert len(g.dedup_by_column(0)) == 2
 
+    def test_string_cluster(self):
+        """`util/StringCluster.java` parity: variant counts per
+        fingerprint, largest cluster first, canonical variant."""
+        from deeplearning4j_tpu.utils.string_grid import StringCluster
+
+        c = StringCluster(["Apple Inc.", "apple inc", "apple inc",
+                           "Banana", "Cherry", "cherry!"])
+        assert len(c) == 3
+        assert c.clusters()[0] == {"Apple Inc.": 1, "apple inc": 2}
+        assert c.canonical("APPLE, inc") == "apple inc"
+        assert c.canonical("unknown thing") == "unknown thing"
+
 
 class TestTimeSeries:
     def test_moving_window_matrix(self):
