@@ -59,18 +59,24 @@ class Evaluation:
         self.false_positives: Dict[int, int] = defaultdict(int)
         self.false_negatives: Dict[int, int] = defaultdict(int)
 
+    def add(self, actual: int, predicted: int) -> None:
+        """Accumulate one (actual, predicted) pair — the primitive both
+        `eval()` and tree-level counters (RNTNEval) go through, so every
+        metric stays consistent with the confusion matrix."""
+        a, p = int(actual), int(predicted)
+        self.confusion.add(a, p)
+        if a == p:
+            self.true_positives[a] += 1
+        else:
+            self.false_positives[p] += 1
+            self.false_negatives[a] += 1
+
     def eval(self, real_outcomes, guesses) -> None:
         """Accumulate from one-hot / probability matrices (Evaluation.eval)."""
         actual = np.argmax(np.asarray(real_outcomes), axis=-1)
         pred = np.argmax(np.asarray(guesses), axis=-1)
         for a, p in zip(actual.ravel(), pred.ravel()):
-            a, p = int(a), int(p)
-            self.confusion.add(a, p)
-            if a == p:
-                self.true_positives[a] += 1
-            else:
-                self.false_positives[p] += 1
-                self.false_negatives[a] += 1
+            self.add(a, p)
 
     # -- metrics -----------------------------------------------------------
     def accuracy(self) -> float:

@@ -272,8 +272,11 @@ class RNTN:
         return float(loss)
 
     # -- inference
-    def predict(self, tree: str | TreeNode) -> Tuple[int, np.ndarray]:
-        """(root label prediction, per-node predictions)."""
+    def predict(self, tree: str | TreeNode, return_plan: bool = False):
+        """(root label prediction, per-node predictions[, the TreePlan]).
+
+        `return_plan=True` hands back the plan built for the forward so
+        evaluators (RNTNEval, accuracy) don't re-plan the same tree."""
         t = parse_tree(tree) if isinstance(tree, str) else tree
         plan_obj = plan_tree(t, self.vocab, self.max_nodes)
         plan = {k: jnp.asarray(getattr(plan_obj, k))
@@ -281,15 +284,15 @@ class RNTN:
                           "valid")}
         _, logits = _forward_one(self.params, plan)
         preds = np.asarray(jnp.argmax(logits, axis=-1))
-        return int(preds[plan_obj.n_nodes - 1]), preds[:plan_obj.n_nodes]
+        out = (int(preds[plan_obj.n_nodes - 1]), preds[:plan_obj.n_nodes])
+        return out + (plan_obj,) if return_plan else out
 
     def accuracy(self, trees: Sequence[str | TreeNode],
                  root_only: bool = True) -> float:
         correct = total = 0
         for s in trees:
             t = parse_tree(s) if isinstance(s, str) else s
-            root_pred, node_preds = self.predict(t)
-            plan = plan_tree(t, self.vocab, self.max_nodes)
+            root_pred, node_preds, plan = self.predict(t, return_plan=True)
             if root_only:
                 if plan.label[plan.n_nodes - 1] >= 0:  # supervised root
                     correct += int(root_pred == plan.label[plan.n_nodes - 1])

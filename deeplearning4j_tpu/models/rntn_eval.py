@@ -1,0 +1,41 @@
+"""RNTN tree-level evaluation.
+
+Parity: reference `models/rntn/RNTNEval.java` — forward-propagate each
+tree, then count (gold label, argmax prediction) for every supervised
+non-leaf node into a ConfusionMatrix, exposing the framework Evaluation
+summary (precision/recall/F1/accuracy/stats).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+from deeplearning4j_tpu.evaluation.evaluation import Evaluation
+from deeplearning4j_tpu.models.rntn import RNTN, TreeNode, parse_tree
+
+
+class RNTNEval:
+    def __init__(self):
+        self.evaluation = Evaluation()
+
+    def eval(self, rntn: RNTN, trees: Sequence["str | TreeNode"]) -> None:
+        """Accumulate per-node confusion counts over `trees` (the
+        reference counts non-leaf nodes with a prediction; unsupervised
+        nodes — label < 0 — are skipped)."""
+        for t in trees:
+            t = parse_tree(t) if isinstance(t, str) else t
+            _, node_preds, plan = rntn.predict(t, return_plan=True)
+            for i in range(plan.n_nodes):
+                if plan.is_leaf[i] or plan.label[i] < 0:
+                    continue
+                self.evaluation.add(int(plan.label[i]), int(node_preds[i]))
+
+    # summary surface (RNTNEval.stats -> Evaluation parity)
+    def accuracy(self) -> float:
+        return self.evaluation.accuracy()
+
+    def f1(self) -> float:
+        return self.evaluation.f1()
+
+    def stats(self) -> str:
+        return self.evaluation.stats()

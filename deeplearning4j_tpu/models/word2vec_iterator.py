@@ -1,0 +1,99 @@
+"""Word2Vec-featurized moving-window DataSet iterator.
+
+Parity: reference `models/word2vec/iterator/Word2VecDataSetIterator.java`
+(+ `Word2VecDataFetcher.java`) — stream a label-aware sentence iterator,
+cut every sentence into moving word windows (`Windows.windows`), featurize
+each window by concatenating the pretrained word2vec vectors of its words
+(`WindowConverter.asExampleMatrix`), and batch (features, one-hot window
+label) pairs into DataSets for window-classification models (the
+Viterbi-decoded sequence labelers).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.text.windows import Window, window_features, windows
+
+
+class Word2VecDataSetIterator:
+    """Batches of word-window examples featurized by a trained Word2Vec.
+
+    `sentence_iter` follows the label-aware contract
+    (`next_sentence()`/`has_next()`/`reset()` + `current_label()`); plain
+    iterators work too when every window should carry `default_label` —
+    include it in `labels` in that case.  A window label outside
+    `labels` raises ValueError (the reference would index at -1).
+    """
+
+    def __init__(self, vec, sentence_iter, labels: Sequence[str],
+                 batch: int = 10, window: Optional[int] = None,
+                 default_label: str = "NONE"):
+        self.vec = vec
+        self.iter = sentence_iter
+        self.labels = list(labels)
+        self.batch = batch
+        self.window = window or getattr(vec, "window", 5)
+        self.default_label = default_label
+        self._label_index = {l: i for i, l in enumerate(self.labels)}
+        self._cache: List[Window] = []
+
+    # -- java-style contract ----------------------------------------------
+    def input_columns(self) -> int:
+        return self.window * self.vec.vector_length
+
+    def total_outcomes(self) -> int:
+        return len(self.labels)
+
+    def reset(self) -> None:
+        self.iter.reset()
+        self._cache.clear()
+
+    def has_next(self) -> bool:
+        # a remaining sentence may tokenize to nothing, so pull until a
+        # real window exists — has_next() True guarantees next() != None
+        self._fill(1)
+        return bool(self._cache)
+
+    def _fill(self, num: int) -> None:
+        while len(self._cache) < num and self.iter.has_next():
+            sentence = self.iter.next_sentence()
+            if not sentence.strip():
+                continue
+            label = (self.iter.current_label()
+                     if hasattr(self.iter, "current_label")
+                     else self.default_label)
+            toks = self.vec.tokenize(sentence) if hasattr(self.vec, "tokenize") \
+                else sentence.split()
+            for w in windows(toks, self.window):
+                w.label = label
+                self._cache.append(w)
+
+    def next(self, num: Optional[int] = None) -> Optional[DataSet]:
+        num = num or self.batch
+        self._fill(num)
+        if not self._cache:
+            return None
+        take, self._cache = self._cache[:num], self._cache[num:]
+        feats = np.stack([
+            window_features(w, self.vec.vector, self.vec.vector_length)
+            for w in take])
+        y = np.zeros((len(take), len(self.labels)), np.float32)
+        for i, w in enumerate(take):
+            idx = self._label_index.get(w.label)
+            if idx is None:
+                raise ValueError(
+                    f"window label {w.label!r} not in labels {self.labels}")
+            y[i, idx] = 1.0
+        return DataSet(feats, y)
+
+    def __iter__(self):
+        self.reset()
+        while self.has_next():
+            ds = self.next()
+            if ds is None:
+                return
+            yield ds

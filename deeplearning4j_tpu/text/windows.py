@@ -16,8 +16,8 @@ import numpy as np
 BEGIN = "<s>"
 END = "</s>"
 
-_BEGIN_LABEL = re.compile(r"^<([A-Za-z]+|\d+)>$")
-_END_LABEL = re.compile(r"^</([A-Za-z]+|\d+)>$")
+_BEGIN_LABEL = re.compile(r"^<([A-Za-z0-9_]+)>$")
+_END_LABEL = re.compile(r"^</([A-Za-z0-9_]+)>$")
 
 
 def string_with_labels(sentence: str, tokenizer_factory=None

@@ -243,3 +243,56 @@ def test_word2vec_binary_handles_multibyte_words(tmp_path):
     for w in words:
         np.testing.assert_allclose(loaded.vector(w), table.vector(w),
                                    rtol=1e-6)
+
+
+def test_word2vec_dataset_iterator():
+    """`Word2VecDataSetIterator.java` parity: moving windows over a
+    label-aware sentence iterator, featurized by the trained w2v vectors,
+    batched with one-hot window labels."""
+    import numpy as np
+
+    from deeplearning4j_tpu.models.word2vec import Word2Vec
+    from deeplearning4j_tpu.models.word2vec_iterator import (
+        Word2VecDataSetIterator)
+    from deeplearning4j_tpu.text.sentence_iterator import (
+        LabelAwareSentenceIterator)
+
+    sents = ["the cat sat", "dogs run fast", "cats nap"]
+    w2v = Word2Vec(vector_length=8, window=3, negative=2,
+                   min_word_frequency=1, epochs=1, seed=0,
+                   batch_size=32).fit([s.split() for s in sents])
+    it = Word2VecDataSetIterator(
+        w2v, LabelAwareSentenceIterator(sents, ["A", "B", "A"]),
+        labels=["A", "B"], batch=4, window=3)
+    assert it.input_columns() == 3 * 8
+    batches = list(it)
+    n_rows = sum(len(b.features) for b in batches)
+    assert n_rows == 8  # 3 + 3 + 2 windows
+    assert all(b.features.shape[1] == 24 for b in batches)
+    # every row's label is one-hot over {A, B}
+    for b in batches:
+        assert np.allclose(b.labels.sum(axis=1), 1.0)
+    # the middle sentence's windows carry label B (index 1)
+    all_labels = np.concatenate([b.labels for b in batches])
+    assert all_labels[:3, 0].all() and all_labels[3:6, 1].all()
+    # iterating again after implicit reset yields the same count
+    assert sum(len(b.features) for b in it) == 8
+
+
+def test_rntn_eval_confusion():
+    """`RNTNEval.java` parity: per-node confusion counts over forwarded
+    trees, surfaced through the framework Evaluation."""
+    from deeplearning4j_tpu.models.rntn import RNTN, TreeNode
+    from deeplearning4j_tpu.models.rntn_eval import RNTNEval
+
+    pos = TreeNode(label=1, left=TreeNode(label=1, word="good"),
+                   right=TreeNode(label=1, word="great"))
+    neg = TreeNode(label=0, left=TreeNode(label=0, word="bad"),
+                   right=TreeNode(label=0, word="awful"))
+    model = RNTN(dim=6, n_classes=2, max_nodes=8, lr=0.1, seed=0)
+    model.fit([pos, neg], epochs=150)
+    ev = RNTNEval()
+    ev.eval(model, [pos, neg])
+    assert ev.evaluation.confusion.total() == 2  # two non-leaf nodes
+    assert ev.accuracy() >= 0.5
+    assert "Accuracy" in ev.stats() or "accuracy" in ev.stats().lower()
