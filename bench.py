@@ -155,9 +155,11 @@ def bench_lenet(devs) -> None:
     x, y = shard_batch(mesh, (x, y), "dp")
 
     key = jax.random.PRNGKey(0)
+    tw = time.perf_counter()
     for _ in range(warmup):
         trainer.state, _ = trainer._step(trainer.state, x, y, key)
     _host_sync(trainer.state.params)
+    warm_s = time.perf_counter() - tw
 
     t0 = time.perf_counter()
     for _ in range(steps):
@@ -169,6 +171,7 @@ def bench_lenet(devs) -> None:
     assumed = 500.0
     _emit("LeNet5-MNIST train samples/sec/chip", per_chip,
           "samples/sec/chip", per_chip / assumed,
+          warmup_seconds=round(warm_s, 1),
           baseline_note=f"assumed {assumed:g} samples/sec, 2015 CPU-jblas")
 
 
@@ -176,7 +179,8 @@ def bench_lenet(devs) -> None:
 # configs[1] — char-LSTM (PTB-style)
 # ---------------------------------------------------------------------------
 
-def _char_lstm_throughput(devs, n_layers: int) -> float:
+def _char_lstm_throughput(devs, n_layers: int):
+    """Returns (chars/sec/chip, warmup seconds)."""
     import jax
     import jax.numpy as jnp
 
@@ -202,35 +206,39 @@ def _char_lstm_throughput(devs, n_layers: int) -> float:
     x, y = shard_batch(mesh, (x, y), "dp")
 
     key = jax.random.PRNGKey(0)
+    tw = time.perf_counter()
     for _ in range(warmup):
         trainer.state, _ = trainer._step(trainer.state, x, y, key)
     _host_sync(trainer.state.params)
+    warm_s = time.perf_counter() - tw
 
     t0 = time.perf_counter()
     for _ in range(steps):
         trainer.state, _ = trainer._step(trainer.state, x, y, key)
     _host_sync(trainer.state.params)
     dt = time.perf_counter() - t0
-    return steps * batch * seq / dt / n_dev
+    return steps * batch * seq / dt / n_dev, warm_s
 
 
 def bench_char_lstm(devs) -> None:
-    chars_per_sec = _char_lstm_throughput(devs, n_layers=1)
+    chars_per_sec, warm_s = _char_lstm_throughput(devs, n_layers=1)
     # reference LSTM.java:161-228 is a scalar per-timestep java loop;
     # era-typical full BPTT on CPU ~ a few k chars/sec
     assumed = 5000.0
     _emit("charLSTM-PTB train chars/sec/chip", chars_per_sec,
           "chars/sec/chip", chars_per_sec / assumed,
+          warmup_seconds=round(warm_s, 1),
           baseline_note=f"assumed {assumed:g} chars/sec, 2015 CPU scalar "
                         "BPTT loop")
 
 
 def bench_char_lstm4(devs) -> None:
     """BASELINE north-star: the 4-layer LSTM trained end-to-end on TPU."""
-    chars_per_sec = _char_lstm_throughput(devs, n_layers=4)
+    chars_per_sec, warm_s = _char_lstm_throughput(devs, n_layers=4)
     assumed = 1500.0  # 4x the BPTT work of the 1-layer CPU loop
     _emit("charLSTM-4layer (north-star) train chars/sec/chip", chars_per_sec,
           "chars/sec/chip", chars_per_sec / assumed,
+          warmup_seconds=round(warm_s, 1),
           baseline_note=f"assumed {assumed:g} chars/sec, 2015 CPU scalar "
                         "BPTT loop x4 layers")
 
@@ -266,9 +274,11 @@ def bench_vgg_cifar10(devs) -> None:
     x, y = shard_batch(mesh, (x, y), "dp")
 
     key = jax.random.PRNGKey(0)
+    tw = time.perf_counter()
     for _ in range(warmup):
         trainer.state, _ = trainer._step(trainer.state, x, y, key)
     _host_sync(trainer.state.params)
+    warm_s = time.perf_counter() - tw
 
     t0 = time.perf_counter()
     for _ in range(steps):
@@ -281,6 +291,7 @@ def bench_vgg_cifar10(devs) -> None:
     assumed = 30.0
     _emit("VGG-CIFAR10 train samples/sec/chip", per_chip,
           "samples/sec/chip", per_chip / assumed,
+          warmup_seconds=round(warm_s, 1),
           baseline_note=f"assumed {assumed:g} samples/sec, 2015 CPU conv")
 
 
@@ -349,9 +360,11 @@ def bench_dp_allreduce(devs) -> None:
     x, y = shard_batch(mesh, (x, y), "dp")
 
     key = jax.random.PRNGKey(0)
+    tw = time.perf_counter()
     for _ in range(warmup):
         trainer.state, _ = trainer._step(trainer.state, x, y, key)
     _host_sync(trainer.state.params)
+    warm_s = time.perf_counter() - tw
 
     t0 = time.perf_counter()
     for _ in range(steps):
@@ -373,7 +386,8 @@ def bench_dp_allreduce(devs) -> None:
                  "metric = full step time only")
     _emit("DP-MLP all-reduce step time", ms, "ms/step",
           assumed_ms / ms,  # >1 = faster than baseline
-          n_devices=n_dev, baseline_note=note)
+          n_devices=n_dev, warmup_seconds=round(warm_s, 1),
+          baseline_note=note)
 
 
 # ---------------------------------------------------------------------------
@@ -427,7 +441,9 @@ def bench_transformer_mfu(devs) -> None:
     # and cost_analysis (r3 re-lowered + re-compiled the d2048xL8 step a
     # second time just to read the FLOP count — minutes of wasted budget)
     key = jax.random.PRNGKey(0)
+    tc = time.perf_counter()
     compiled = trainer._step.lower(trainer.state, x, y, key).compile()
+    compile_s = time.perf_counter() - tc
     for _ in range(warmup):
         trainer.state, _ = compiled(trainer.state, x, y, key)
     _host_sync(trainer.state.params)
@@ -464,11 +480,13 @@ def bench_transformer_mfu(devs) -> None:
               peak_tflops_per_chip=round(peak / 1e12, 1),
               device_kind=devs[0].device_kind,
               tokens_per_sec=round(tokens / dt_step, 1),
+              compile_seconds=round(compile_s, 1),
               config=f"d{d_model}xL{blocks}xS{seq}xB{batch} bf16 dense-attn")
     else:
         _emit("charTransformer train FLOPs/sec", achieved, "FLOP/s", None,
               device_kind=devs[0].device_kind,
-              tokens_per_sec=round(tokens / dt_step, 1))
+              tokens_per_sec=round(tokens / dt_step, 1),
+              compile_seconds=round(compile_s, 1))
 
 
 # ---------------------------------------------------------------------------

@@ -93,18 +93,13 @@ class Glove:
         sentences = sentences if sentences is not None else self.sentences
         # two streaming passes (vocab count, then co-occurrence count) so
         # a disk-backed corpus (DiskInvertedIndex.docs()) never lands in
-        # RAM as token text; one-shot iterators are materialized
-        if iter(sentences) is iter(sentences):
-            sentences = list(sentences)
+        # RAM as token text; TokenCorpus materializes one-shot iterators
+        from deeplearning4j_tpu.text.corpus import TokenCorpus
 
-        def token_lists():
-            for s in sentences:
-                yield (self.tokenizer.tokenize(s) if isinstance(s, str)
-                       else list(s))
-
-        self.cache = VocabCache(self.min_word_frequency).fit(token_lists())
+        token_lists = TokenCorpus(sentences, self.tokenizer.tokenize)
+        self.cache = VocabCache(self.min_word_frequency).fit(token_lists)
         co = CoOccurrences(self.window)
-        for toks in token_lists():
+        for toks in token_lists:
             ids = [self.cache.index_of(t) for t in toks if t in self.cache]
             co.add_sentence(ids)
         wi, wj, x = co.arrays()

@@ -250,20 +250,16 @@ class Word2Vec:
         # or a DiskInvertedIndex.docs() view streaming off disk — is
         # walked twice, holding int32 id arrays only (the
         # LuceneInvertedIndex role: corpora >> RAM feed mini-batching).
-        # A one-shot iterator is materialized for compatibility.
-        if iter(sentences) is iter(sentences):
-            sentences = list(sentences)
+        # TokenCorpus materializes one-shot outer/inner iterators.
+        from deeplearning4j_tpu.text.corpus import TokenCorpus
 
-        def token_lists():
-            for s in sentences:
-                yield self.tokenize(s) if isinstance(s, str) else list(s)
-
+        token_lists = TokenCorpus(sentences, self.tokenize)
         if self.cache is None:
-            self.build_vocab(token_lists())
+            self.build_vocab(token_lists)
         ids_per_sentence = [
             np.asarray([self.cache.index_of(t) for t in toks
                         if t in self.cache], np.int32)
-            for toks in token_lists()]
+            for toks in token_lists]
 
         codes_all, points_all, mask_all = Huffman.padded_arrays(self.cache)
         if not self.use_hs:
