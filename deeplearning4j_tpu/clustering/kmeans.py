@@ -24,6 +24,24 @@ from deeplearning4j_tpu.clustering.cluster import Cluster, ClusterSet, Point
 from deeplearning4j_tpu.nd.ops import pairwise_sq_dists as _pairwise_sq_dists
 
 
+def kmeanspp_seed(x: np.ndarray, k: int,
+                  rng: np.random.RandomState) -> np.ndarray:
+    """k-means++ D^2-weighted seeding (host side; k draws over n).
+    Shared by the jitted fast path below and the strategy framework
+    (`clustering/strategy.BaseClusteringAlgorithm`)."""
+    centers = [x[rng.randint(len(x))]]
+    d2 = ((x - centers[0]) ** 2).sum(1)
+    for _ in range(1, k):
+        total = d2.sum()
+        if total <= 0:  # all remaining points coincide with a center
+            centers.append(x[rng.randint(len(x))])
+            continue
+        i = int(rng.choice(len(x), p=d2 / total))
+        centers.append(x[i])
+        d2 = np.minimum(d2, ((x - x[i]) ** 2).sum(1))
+    return np.stack(centers)
+
+
 @partial(jax.jit, static_argnums=(2, 3))
 def _lloyd(x, init_centers, max_iters: int, tol: float):
     """Full Lloyd loop under jit: while (moved > tol and iters < max)."""
@@ -95,18 +113,7 @@ class KMeansClustering:
 
     def _kmeanspp_seed(self, x: np.ndarray,
                        rng: np.random.RandomState) -> np.ndarray:
-        """k-means++ D^2-weighted seeding (host side; k draws over n)."""
-        centers = [x[rng.randint(len(x))]]
-        d2 = ((x - centers[0]) ** 2).sum(1)
-        for _ in range(1, self.k):
-            total = d2.sum()
-            if total <= 0:  # all remaining points coincide with a center
-                centers.append(x[rng.randint(len(x))])
-                continue
-            i = int(rng.choice(len(x), p=d2 / total))
-            centers.append(x[i])
-            d2 = np.minimum(d2, ((x - x[i]) ** 2).sum(1))
-        return np.stack(centers)
+        return kmeanspp_seed(x, self.k, rng)
 
     def apply_to(self, points) -> ClusterSet:
         """Cluster a list of Points or an (n,d) matrix → ClusterSet."""

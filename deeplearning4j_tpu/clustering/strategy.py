@@ -27,7 +27,7 @@ from __future__ import annotations
 import enum
 from dataclasses import dataclass, field
 from functools import partial
-from typing import Dict, List, Optional
+from typing import List, Optional
 
 import jax
 import jax.numpy as jnp
@@ -315,18 +315,9 @@ class BaseClusteringAlgorithm:
     # -- pieces ------------------------------------------------------------
     def _init_centers(self, x: np.ndarray,
                       rng: np.random.RandomState) -> np.ndarray:
-        k = self.strategy.initial_cluster_count
-        centers = [x[rng.randint(len(x))]]
-        d2 = ((x - centers[0]) ** 2).sum(1)
-        for _ in range(1, k):
-            total = d2.sum()
-            if total <= 0:
-                centers.append(x[rng.randint(len(x))])
-                continue
-            i = int(rng.choice(len(x), p=d2 / total))
-            centers.append(x[i])
-            d2 = np.minimum(d2, ((x - x[i]) ** 2).sum(1))
-        return np.stack(centers)
+        from deeplearning4j_tpu.clustering.kmeans import kmeanspp_seed
+
+        return kmeanspp_seed(x, self.strategy.initial_cluster_count, rng)
 
     @staticmethod
     def _split_cluster(centers: np.ndarray, x: np.ndarray,
@@ -344,9 +335,10 @@ class BaseClusteringAlgorithm:
         centers[target] = x[far]
         return centers
 
-    def _apply_strategy(self, centers, x, assign, dist, stats) -> bool:
-        """Empty-cluster repair + optimisation phase; returns whether the
-        strategy changed the centers (`IterationInfo.strategyApplied`)."""
+    def _apply_strategy(self, centers, x, assign, dist, stats):
+        """Empty-cluster repair + optimisation phase; returns
+        (centers, strategy_applied) — the flag feeds
+        `IterationInfo.strategyApplied`."""
         applied = False
         counts = np.asarray(stats["counts"])
         if not self.strategy.allow_empty_clusters:

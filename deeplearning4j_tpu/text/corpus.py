@@ -15,7 +15,7 @@ The edge cases live here once instead of per-model:
 
 from __future__ import annotations
 
-from typing import Callable, Dict, Iterator, List, Sequence
+from typing import Callable, Dict, Iterator, List
 
 
 class TokenCorpus:
