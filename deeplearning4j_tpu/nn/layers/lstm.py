@@ -57,7 +57,10 @@ class LSTMLayer:
         # measured on v5e with host-synced timing: the Pallas cell beats
         # XLA's scan fusion ~25% (70.6 vs 94.4 ms/fwd at B=64 T=64
         # 256->512), so "auto" uses it on TPU; interpret-mode overhead
-        # makes scan the right default elsewhere
+        # makes scan the right default elsewhere.  NOTE: that measurement
+        # predates the hoisted input projection in the scan path below —
+        # re-measure on chip (lstm_impl="scan" vs "fused") before trusting
+        # "auto" for a new config.
         impl = getattr(conf, "lstm_impl", "auto")
         if impl == "auto":
             return jax.devices()[0].platform == "tpu"
