@@ -76,8 +76,16 @@ def _zoo_conf(spec: str, data):
     if name == "vgg_cifar10":
         return zoo.vgg_cifar10(lr=lr, iterations=iters,
                                width=int(kw.get("width", 64)))
+    if name == "dbn":
+        hidden = [int(h) for h in kw.get("hidden", "32x16").split("x")]
+        return zoo.dbn(n_in=data.features.shape[-1], hidden=hidden,
+                       n_out=data.labels.shape[-1], lr=lr,
+                       iterations=int(kw.get("iterations",
+                                             kw.get("iters", 30))),
+                       k=int(kw.get("k", 1)),
+                       finetune_iterations=int(kw.get("finetune", 60)))
     raise SystemExit(f"unknown --zoo model '{name}' (choose lenet5, mlp, "
-                     "char_lstm, char_transformer, vgg_cifar10)")
+                     "char_lstm, char_transformer, vgg_cifar10, dbn)")
 
 
 def cmd_train(args) -> int:
@@ -108,6 +116,8 @@ def cmd_train(args) -> int:
         data = ds
     if args.normalize:
         data = data.normalize_zero_mean_unit_variance()
+    if getattr(args, "scale_01", False):
+        data = data.scale_to_unit()
 
     props = _parse_properties(args.properties)
     epochs = int(props.get("epochs", "1"))
@@ -167,6 +177,8 @@ def cmd_test(args) -> int:
                       num_examples=args.num_examples)
     if args.normalize:
         data = data.normalize_zero_mean_unit_variance()
+    if getattr(args, "scale_01", False):
+        data = data.scale_to_unit()
     ev = Evaluation()
     ev.eval(data.labels, net.output(data.features))
     print(ev.stats())
@@ -184,6 +196,8 @@ def cmd_predict(args) -> int:
                       num_examples=args.num_examples)
     if args.normalize:
         data = data.normalize_zero_mean_unit_variance()
+    if getattr(args, "scale_01", False):
+        data = data.scale_to_unit()
     probs = np.asarray(net.output(data.features))
     preds = probs.argmax(axis=-1)
     if args.output:
@@ -207,6 +221,9 @@ def _add_common(p: argparse.ArgumentParser) -> None:
                    help="conf JSON (train) or checkpoint dir (test/predict)")
     p.add_argument("--label-column", type=int, default=-1)
     p.add_argument("--num-examples", type=int, default=None)
+    p.add_argument("--scale-01", dest="scale_01", action="store_true",
+                   help="min-max scale features into [0, 1] (RBM/DBN "
+                        "visible units)")
     p.add_argument("--normalize", action="store_true",
                    help="zero-mean/unit-variance features")
 

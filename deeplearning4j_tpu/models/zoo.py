@@ -65,6 +65,28 @@ def mlp(n_in: int, hidden, n_out: int, lr: float = 0.1,
     return MultiLayerConfiguration(confs=tuple(confs), backprop=True)
 
 
+def dbn(n_in: int, hidden, n_out: int, lr: float = 0.05,
+        iterations: int = 30, k: int = 1,
+        finetune_iterations: int = 60) -> MultiLayerConfiguration:
+    """Deep belief net — the reference's signature 2015 workflow
+    (`MultiLayerTest.java` DBN-on-Iris/LFW pattern): a stack of sigmoid
+    RBMs greedily pretrained with CD-k, then an output layer finetuned
+    with conjugate gradient.  Features should be scaled into [0, 1] for
+    the binary visible units."""
+    b = _base(lr=lr, iters=iterations).replace(
+        layer_type=LayerType.RBM, activation=Activation.SIGMOID, k=k)
+    dims = [n_in] + list(hidden)
+    confs = [b.replace(n_in=dims[i], n_out=dims[i + 1])
+             for i in range(len(dims) - 1)]
+    confs.append(b.replace(
+        layer_type=LayerType.OUTPUT, n_in=dims[-1], n_out=n_out,
+        activation=Activation.SOFTMAX, loss_function=LossFunction.MCXENT,
+        lr=2 * lr, num_iterations=finetune_iterations,
+        optimization_algo=OptimizationAlgorithm.CONJUGATE_GRADIENT))
+    return MultiLayerConfiguration(confs=tuple(confs), pretrain=True,
+                                   backprop=True)
+
+
 def char_lstm(vocab: int, hidden: int = 256, n_layers: int = 1,
               lr: float = 0.1, iterations: int = 1
               ) -> MultiLayerConfiguration:

@@ -132,3 +132,20 @@ def test_evaluation_metrics():
     assert ev.accuracy() == 5 / 6
     assert ev.confusion.count(1, 2) == 1
     assert 0 < ev.f1() <= 1
+
+
+def test_dbn_zoo_config_trains_iris():
+    """`zoo.dbn` — the reference's flagship DBN workflow as a one-call
+    config: RBM-stack pretrain (CD-k) then CG finetune on Iris."""
+    from deeplearning4j_tpu.models.zoo import dbn
+
+    conf = dbn(4, [12, 8], 3, iterations=30, finetune_iterations=60)
+    assert conf.pretrain and conf.backprop
+    data = IrisDataFetcher().fetch(150)
+    f = data.features
+    f = (f - f.min(0)) / (f.max(0) - f.min(0) + 1e-6)
+    net = MultiLayerNetwork(conf, seed=1).init()
+    net.fit(f, data.labels)
+    ev = Evaluation()
+    ev.eval(data.labels, net.output(f))
+    assert ev.accuracy() > 0.85, ev.stats()
