@@ -87,6 +87,36 @@ def dbn(n_in: int, hidden, n_out: int, lr: float = 0.05,
                                    backprop=True)
 
 
+def deep_autoencoder(n_in: int = 784, hidden=(400, 200, 100, 50, 25, 6),
+                     lr: float = 0.05, iterations: int = 30,
+                     finetune_iterations: int = 60,
+                     corruption: float = 0.3) -> MultiLayerConfiguration:
+    """Hinton-style deep autoencoder — the reference's Curves workflow
+    (`CurvesDataFetcher.java` + stacked `AutoEncoder.java` pretraining):
+    a denoising-AE encoder stack greedily pretrained layer by layer, a
+    mirrored sigmoid decoder, and a RECONSTRUCTION_CROSSENTROPY output
+    finetuned end-to-end against the inputs (fit(x, x))."""
+    b = _base(lr=lr, iters=iterations).replace(
+        activation=Activation.SIGMOID)
+    dims = [n_in] + list(hidden)
+    confs = [b.replace(layer_type=LayerType.AUTOENCODER, n_in=dims[i],
+                       n_out=dims[i + 1], corruption_level=corruption)
+             for i in range(len(dims) - 1)]
+    # mirrored decoder: plain sigmoid dense layers back up the stack
+    rev = list(reversed(dims))
+    confs += [b.replace(layer_type=LayerType.DENSE, n_in=rev[i],
+                        n_out=rev[i + 1])
+              for i in range(len(rev) - 2)]
+    confs.append(b.replace(
+        layer_type=LayerType.OUTPUT, n_in=rev[-2], n_out=n_in,
+        activation=Activation.SIGMOID,
+        loss_function=LossFunction.RECONSTRUCTION_CROSSENTROPY,
+        num_iterations=finetune_iterations,
+        optimization_algo=OptimizationAlgorithm.CONJUGATE_GRADIENT))
+    return MultiLayerConfiguration(confs=tuple(confs), pretrain=True,
+                                   backprop=True)
+
+
 def char_lstm(vocab: int, hidden: int = 256, n_layers: int = 1,
               lr: float = 0.1, iterations: int = 1
               ) -> MultiLayerConfiguration:

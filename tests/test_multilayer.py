@@ -149,3 +149,32 @@ def test_dbn_zoo_config_trains_iris():
     ev = Evaluation()
     ev.eval(data.labels, net.output(f))
     assert ev.accuracy() > 0.85, ev.stats()
+
+
+def test_deep_autoencoder_zoo_on_curves():
+    """`zoo.deep_autoencoder` — the reference's Curves deep-AE workflow:
+    denoising-AE stack pretrain, mirrored decoder, reconstruction
+    finetune; the trained net reconstructs far better than at init."""
+    import numpy as np
+
+    from deeplearning4j_tpu.datasets.fetchers import CurvesDataFetcher
+    from deeplearning4j_tpu.models.zoo import deep_autoencoder
+
+    data = CurvesDataFetcher().fetch(120)
+    conf = deep_autoencoder(784, hidden=(64,), iterations=20,
+                            finetune_iterations=100, lr=0.1)
+    assert conf.pretrain and conf.backprop
+    net = MultiLayerNetwork(conf, seed=1).init()
+    recon0 = np.asarray(net.output(data.features))
+    mse0 = float(np.mean((recon0 - data.features) ** 2))
+    net.fit(data.features, data.features)
+    recon = np.asarray(net.output(data.features))
+    assert recon.shape == data.features.shape
+    mse = float(np.mean((recon - data.features) ** 2))
+    var = float(np.var(data.features))
+    # reconstruction beats the mean-predictor baseline (variance) by a
+    # wide margin and vastly improves on the untrained net; the xent
+    # SCORE has an entropy floor with soft [0,1] targets, so MSE is the
+    # honest criterion (measured: 0.026 -> 0.005 vs var 0.023)
+    assert mse < 0.5 * var, (mse, var)
+    assert mse < 0.4 * mse0, (mse0, mse)
