@@ -84,8 +84,15 @@ def _zoo_conf(spec: str, data):
                                              kw.get("iters", 30))),
                        k=int(kw.get("k", 1)),
                        finetune_iterations=int(kw.get("finetune", 60)))
+    if name == "deep_autoencoder":
+        hidden = [int(h) for h in kw.get("hidden", "64x16").split("x")]
+        return zoo.deep_autoencoder(
+            n_in=data.features.shape[-1], hidden=hidden, lr=lr,
+            iterations=int(kw.get("iterations", kw.get("iters", 20))),
+            finetune_iterations=int(kw.get("finetune", 100)))
     raise SystemExit(f"unknown --zoo model '{name}' (choose lenet5, mlp, "
-                     "char_lstm, char_transformer, vgg_cifar10, dbn)")
+                     "char_lstm, char_transformer, vgg_cifar10, dbn, "
+                     "deep_autoencoder)")
 
 
 def cmd_train(args) -> int:
@@ -154,8 +161,18 @@ def cmd_train(args) -> int:
         trainer.fit(data.batch_by(batch), epochs=epochs)
     else:
         net = MultiLayerNetwork(conf).init()
+        deep_ae = (getattr(args, "zoo", None) or "").split(":")[0] \
+            == "deep_autoencoder"
         for _ in range(epochs):
-            net.fit(data.features, data.labels)
+            if deep_ae:
+                # pretrain -> unroll decoder from the pretrained encoder
+                # -> reconstruction finetune (Hinton's recipe)
+                from deeplearning4j_tpu.models.zoo import (
+                    fit_deep_autoencoder)
+
+                fit_deep_autoencoder(net, data.features)
+            else:
+                net.fit(data.features, data.labels)
 
     train_seconds = _time.perf_counter() - t_train
     score = net.score(data.features, data.labels)

@@ -95,7 +95,13 @@ def deep_autoencoder(n_in: int = 784, hidden=(400, 200, 100, 50, 25, 6),
     (`CurvesDataFetcher.java` + stacked `AutoEncoder.java` pretraining):
     a denoising-AE encoder stack greedily pretrained layer by layer, a
     mirrored sigmoid decoder, and a RECONSTRUCTION_CROSSENTROPY output
-    finetuned end-to-end against the inputs (fit(x, x))."""
+    finetuned end-to-end against the inputs (fit(x, x)).  After
+    pretraining, `unroll_autoencoder_stack` copies the encoder weights
+    transposed into the decoder (Hinton's unrolling) — use
+    `fit_deep_autoencoder` to get pretrain -> unroll -> finetune in one
+    call."""
+    if not hidden:
+        raise ValueError("deep_autoencoder needs at least one hidden size")
     b = _base(lr=lr, iters=iterations).replace(
         activation=Activation.SIGMOID)
     dims = [n_in] + list(hidden)
@@ -115,6 +121,38 @@ def deep_autoencoder(n_in: int = 784, hidden=(400, 200, 100, 50, 25, 6),
         optimization_algo=OptimizationAlgorithm.CONJUGATE_GRADIENT))
     return MultiLayerConfiguration(confs=tuple(confs), pretrain=True,
                                    backprop=True)
+
+
+def unroll_autoencoder_stack(conf: MultiLayerConfiguration, params):
+    """Hinton's unrolling for a `deep_autoencoder` net: decoder layer p
+    mirrors encoder AE layer L-1-p, so its weights become the PRETRAINED
+    encoder weights transposed (W_dec = W_enc.T) and its bias the
+    encoder's visible bias vb — instead of leaving the decoder at random
+    init, which forces finetuning to train a deep random decoder through
+    the bottleneck."""
+    n_enc = sum(1 for c in conf.confs
+                if LayerType(str(c.layer_type)) == LayerType.AUTOENCODER)
+    params = list(params)
+    for p in range(n_enc):  # decoder positions, incl. the OUTPUT layer
+        enc = dict(params[n_enc - 1 - p])
+        dec_idx = n_enc + p
+        dec = dict(params[dec_idx])
+        dec["W"] = enc["W"].T
+        dec["b"] = enc["vb"]
+        params[dec_idx] = dec
+    return tuple(params)
+
+
+def fit_deep_autoencoder(net, x):
+    """pretrain (greedy AE stack) -> unroll decoder -> reconstruction
+    finetune; `net` wraps a `deep_autoencoder` configuration."""
+    import jax.numpy as jnp
+
+    x = jnp.asarray(x)
+    net.pretrain(x)
+    net.params = unroll_autoencoder_stack(net.conf, net.params)
+    net.finetune(x, x)
+    return net
 
 
 def char_lstm(vocab: int, hidden: int = 256, n_layers: int = 1,
