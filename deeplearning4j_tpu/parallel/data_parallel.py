@@ -36,6 +36,10 @@ from deeplearning4j_tpu.optimize.updater import (UpdaterState, adjust_gradient,
                                                  init_updater)
 from deeplearning4j_tpu.parallel.mesh import shard_batch
 
+import logging
+
+log = logging.getLogger(__name__)
+
 
 class TrainState(NamedTuple):
     """Carried training state — params + updater state + step counter.
@@ -68,7 +72,8 @@ def _feature_row_weights(w, x):
 
 
 def make_dp_train_step(conf: MultiLayerConfiguration, mesh: Mesh,
-                       axis: str = "dp", masked: bool = False):
+                       axis: str = "dp", masked: bool = False,
+                       grad_accum: int = 1):
     """Compile one data-parallel training step.
 
     Unmasked (default): `step(state, x, y, key) -> (state, mean_score)`,
@@ -83,10 +88,23 @@ def make_dp_train_step(conf: MultiLayerConfiguration, mesh: Mesh,
     psum(sum(w)) + regularization; gradients via psum of per-shard
     contributions (exact global weighted mean).  BATCH_NORM statistics are
     weighted the same way (pad rows don't skew the normalization).
+
+    grad_accum=k splits each shard's batch into k microbatches, runs the
+    forward/backward per microbatch under `lax.scan` (peak activation
+    memory drops ~k-fold) and applies ONE update from the averaged
+    gradients — for dropout-free networks numerically the plain step's
+    gradient exactly (mean of equal-size microbatch means; dropout draws
+    a fresh key per microbatch, so masks differ from the one-key plain
+    step).  Only the unmasked, batchnorm-free path supports it (BN would
+    see microbatch statistics); the per-shard batch must be divisible by
+    k (checked at trace time).
     """
     out_conf = conf.conf(conf.n_layers - 1)
     n_shards = mesh.shape[axis]
     collect_bn = has_batchnorm(conf)
+    if grad_accum > 1 and (masked or collect_bn):
+        raise ValueError("grad_accum requires the unmasked path on a "
+                         "batchnorm-free network")
 
     def local_step(state: TrainState, x, y, w, key):
         # distinct per-shard dropout keys, same param update everywhere
@@ -108,8 +126,46 @@ def make_dp_train_step(conf: MultiLayerConfiguration, mesh: Mesh,
                         + network_regularization(conf, p) / n_shards)
             return loss, stats
 
-        (score, stats), grads = jax.value_and_grad(
-            loss_fn, has_aux=True)(state.params, key)
+        if grad_accum > 1:
+            # microbatch scan: one fwd/bwd per slice, gradients averaged
+            if x.shape[0] % grad_accum or y.shape[0] % grad_accum:
+                raise ValueError(
+                    f"per-shard batch {x.shape[0]} (labels {y.shape[0]}) "
+                    f"not divisible by grad_accum={grad_accum}")
+            xs = x.reshape(grad_accum, x.shape[0] // grad_accum,
+                           *x.shape[1:])
+            # label rows may be a multiple of feature rows (B*T for
+            # sequence models); row order is batch-major so block
+            # splitting stays aligned with x's microbatches
+            ys = y.reshape(grad_accum, y.shape[0] // grad_accum,
+                           *y.shape[1:])
+
+            def micro_loss(p, k, xm, ym):
+                rows = network_rowwise_loss(conf, p, xm, ym, k,
+                                            training=True)
+                return jnp.mean(rows) + network_regularization(conf, p)
+
+            def micro(carry, inp):
+                g_acc, s_acc, k = carry
+                xm, ym = inp
+                k, sub = jax.random.split(k)
+                s, g = jax.value_and_grad(micro_loss)(state.params, sub,
+                                                      xm, ym)
+                g_acc = jax.tree_util.tree_map(jnp.add, g_acc, g)
+                return (g_acc, s_acc + s, k), None
+
+            from deeplearning4j_tpu.parallel.sequence import _as_varying
+            g0 = jax.tree_util.tree_map(
+                lambda p: _as_varying(jnp.zeros_like(p), axis),
+                state.params)
+            s0 = _as_varying(jnp.zeros((), jnp.float32), axis)
+            (grads, score, _), _ = jax.lax.scan(micro, (g0, s0, key),
+                                                (xs, ys))
+            grads = jax.tree_util.tree_map(lambda g: g / grad_accum, grads)
+            score = score / grad_accum
+        else:
+            (score, stats), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(state.params, key)
         # the all-reduce: what Hazelcast/Spark moved as whole param vectors
         reduce = jax.lax.pmean if w is None else jax.lax.psum
         grads = reduce(grads, axis)
@@ -320,7 +376,7 @@ class DataParallelTrainer:
 
     def __init__(self, net: MultiLayerNetwork, mesh: Mesh,
                  mode: str = "sync", local_steps: int = 5,
-                 axis: str = "dp", listeners=()):
+                 axis: str = "dp", listeners=(), grad_accum: int = 1):
         self.net = net
         self.mesh = mesh
         self.axis = axis
@@ -329,13 +385,18 @@ class DataParallelTrainer:
         if net.params is None:
             net.init()
         if mode == "sync":
-            self._step = make_dp_train_step(net.conf, mesh, axis)
+            self._step = make_dp_train_step(net.conf, mesh, axis,
+                                            grad_accum=grad_accum)
         elif mode == "averaging":
+            if grad_accum > 1:
+                raise ValueError(
+                    "grad_accum is only supported in mode='sync'")
             self._step = make_averaging_round(net.conf, mesh, local_steps,
                                               axis)
         else:
             raise ValueError(f"unknown mode {mode!r}")
         self._local_steps = local_steps
+        self._grad_accum = grad_accum
         self._masked_step = None  # built lazily on first remainder batch
         self.state = init_train_state(net)
         self._key = jax.random.PRNGKey(net.conf.confs[0].seed or 0)
@@ -368,6 +429,13 @@ class DataParallelTrainer:
         pad = n_dp - b % n_dp
         ratio = max(1, y.shape[0] // max(1, b))
         if self._masked_step is None:
+            if self._grad_accum > 1:
+                # the masked path has no accumulation: the tail batch runs
+                # one full fwd/bwd — warn, since accumulation is usually
+                # chosen for activation-memory headroom
+                log.warning(
+                    "remainder batch of %d runs the masked step WITHOUT "
+                    "grad_accum=%d (single fwd/bwd)", b, self._grad_accum)
             if self.mode == "sync":
                 self._masked_step = make_masked_dp_train_step(
                     self.net.conf, self.mesh, self.axis)
