@@ -166,3 +166,25 @@ class TestTimeSeries:
     def test_window_too_large_raises(self):
         with pytest.raises(ValueError):
             moving_window_matrix(np.arange(3), 5)
+
+
+class TestParallelization:
+    def test_run_in_parallel_results_in_order(self):
+        from deeplearning4j_tpu.utils.parallelization import (
+            iterate_in_parallel, run_in_parallel)
+
+        out = run_in_parallel([lambda i=i: i * i for i in range(20)])
+        assert out == [i * i for i in range(20)]
+        assert run_in_parallel([]) == []
+        assert iterate_in_parallel([3, 1, 2], lambda v: v + 10) == [13, 11, 12]
+
+    def test_exception_propagates(self):
+        import pytest
+
+        from deeplearning4j_tpu.utils.parallelization import run_in_parallel
+
+        def boom():
+            raise RuntimeError("task failed")
+
+        with pytest.raises(RuntimeError, match="task failed"):
+            run_in_parallel([lambda: 1, boom, lambda: 2])
