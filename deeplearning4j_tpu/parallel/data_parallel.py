@@ -35,6 +35,7 @@ from deeplearning4j_tpu.nn.multilayer import (MultiLayerNetwork, has_batchnorm,
 from deeplearning4j_tpu.optimize.updater import (UpdaterState, adjust_gradient,
                                                  init_updater)
 from deeplearning4j_tpu.parallel.mesh import shard_batch
+from deeplearning4j_tpu.parallel.sequence import _as_varying
 
 import logging
 
@@ -109,6 +110,16 @@ def make_dp_train_step(conf: MultiLayerConfiguration, mesh: Mesh,
     def local_step(state: TrainState, x, y, w, key):
         # distinct per-shard dropout keys, same param update everywhere
         key = jax.random.fold_in(key, jax.lax.axis_index(axis))
+        # differentiate w.r.t. a VARYING view of the replicated params:
+        # under check_vma, the cotangent of an invariant input gets an
+        # implicit psum inserted by the transpose (grads arrive already
+        # summed over dp), which would make the explicit pmean/psum
+        # below scale the update by n_dp. Marking params varying keeps
+        # the cotangents per-shard so OUR collective does the reduction
+        # (exposed by plain-SGD configs; adagrad's sign-like first step
+        # masked it).
+        var_params = jax.tree_util.tree_map(
+            lambda p: _as_varying(p, axis), state.params)
         wx = None if w is None else _feature_row_weights(w, x)
         if w is not None:
             den = jnp.maximum(jax.lax.psum(jnp.sum(w), axis), 1.0)
@@ -149,12 +160,11 @@ def make_dp_train_step(conf: MultiLayerConfiguration, mesh: Mesh,
                 g_acc, s_acc, k = carry
                 xm, ym = inp
                 k, sub = jax.random.split(k)
-                s, g = jax.value_and_grad(micro_loss)(state.params, sub,
+                s, g = jax.value_and_grad(micro_loss)(var_params, sub,
                                                       xm, ym)
                 g_acc = jax.tree_util.tree_map(jnp.add, g_acc, g)
                 return (g_acc, s_acc + s, k), None
 
-            from deeplearning4j_tpu.parallel.sequence import _as_varying
             g0 = jax.tree_util.tree_map(
                 lambda p: _as_varying(jnp.zeros_like(p), axis),
                 state.params)
@@ -165,7 +175,7 @@ def make_dp_train_step(conf: MultiLayerConfiguration, mesh: Mesh,
             score = score / grad_accum
         else:
             (score, stats), grads = jax.value_and_grad(
-                loss_fn, has_aux=True)(state.params, key)
+                loss_fn, has_aux=True)(var_params, key)
         # the all-reduce: what Hazelcast/Spark moved as whole param vectors
         reduce = jax.lax.pmean if w is None else jax.lax.psum
         grads = reduce(grads, axis)
@@ -224,6 +234,92 @@ def make_sharded_train_step(conf: MultiLayerConfiguration, mesh: Mesh):
         return TrainState(params, upd, state.step + 1), score
 
     return jax.jit(step_fn, donate_argnums=(0,))
+
+
+def zero1_pspecs(tree, mesh: Mesh, axis: str = "dp"):
+    """ZeRO-1 PartitionSpecs for an updater-state pytree: each leaf
+    shards its first dp-divisible dimension over `axis`; indivisible or
+    scalar leaves replicate.  (New scope beyond the reference — ZeRO is
+    a 2020s memory optimization; the 2015 reference replicates
+    everything.)"""
+    size = mesh.shape[axis]
+
+    def spec(x):
+        for d in range(getattr(x, "ndim", 0)):
+            if x.shape[d] % size == 0 and x.shape[d] >= size:
+                return P(*([None] * d + [axis]))
+        return P()
+
+    return jax.tree_util.tree_map(spec, tree)
+
+
+def make_zero1_train_step(conf: MultiLayerConfiguration, mesh: Mesh,
+                          axis: str = "dp"):
+    """Data-parallel step with ZeRO-1 optimizer-state sharding, built on
+    GSPMD sharding annotations instead of manual collectives: the batch
+    is dp-sharded, params stay replicated, and the AdaGrad/momentum (or
+    adam m/v) state lives SHARDED over the dp axis — 1/n_dp of the
+    optimizer memory per chip.  `with_sharding_constraint` on the
+    gradients entering the updater makes XLA lower the dp grad reduction
+    as a reduce-scatter, the elementwise updater math runs shard-local,
+    and the parameter update all-gathers the adjusted step — the ZeRO-1
+    communication schedule, derived by the partitioner from layout
+    constraints rather than hand-written ppermutes.
+
+    Use with `zero1_shard_state(state, mesh)`; step signature matches
+    `make_dp_train_step` (state, x, y, key) -> (state, score)."""
+    out_conf = conf.conf(conf.n_layers - 1)
+    collect_bn = has_batchnorm(conf)
+    if collect_bn:
+        raise ValueError("zero1 step does not support BatchNorm nets "
+                         "(per-batch stats need the shard_map path)")
+
+    def step_fn(state: TrainState, x, y, key):
+        def loss_fn(p, k):
+            rows = network_rowwise_loss(conf, p, x, y, k, training=True)
+            return jnp.mean(rows) + network_regularization(conf, p)
+
+        score, grads = jax.value_and_grad(loss_fn)(state.params, key)
+        # pin the gradient layout to the updater's sharded layout: the
+        # dp-mean above then lowers as reduce-scatter(+partial sums)
+        # instead of a full all-reduce
+        gspecs = zero1_pspecs(grads, mesh, axis)
+        grads = jax.tree_util.tree_map(
+            lambda g, s: jax.lax.with_sharding_constraint(
+                g, NamedSharding(mesh, s)), grads, gspecs)
+        adj, upd = adjust_gradient(out_conf, state.step, grads,
+                                   state.params, state.updater)
+        params = jax.tree_util.tree_map(
+            lambda p, a: p - a.astype(p.dtype), state.params, adj)
+        # params come back replicated (all-gather of the sharded step)
+        params = jax.tree_util.tree_map(
+            lambda p: jax.lax.with_sharding_constraint(
+                p, NamedSharding(mesh, P())), params)
+        return TrainState(params, upd, state.step + 1), score
+
+    return jax.jit(step_fn, donate_argnums=(0,))
+
+
+def zero1_shard_state(state: TrainState, mesh: Mesh, axis: str = "dp"):
+    """Place a TrainState for the ZeRO-1 step: params replicated, updater
+    state sharded over `axis` (its per-chip footprint drops n_dp-fold)."""
+    rep = NamedSharding(mesh, P())
+
+    def put_rep(tree):
+        return jax.tree_util.tree_map(
+            lambda a: jax.device_put(a, rep), tree)
+
+    def put_sharded(tree):
+        specs = zero1_pspecs(tree, mesh, axis)
+        return jax.tree_util.tree_map(
+            lambda a, s: jax.device_put(a, NamedSharding(mesh, s)),
+            tree, specs)
+
+    return TrainState(params=put_rep(state.params),
+                      updater=UpdaterState(
+                          adagrad_hist=put_sharded(state.updater.adagrad_hist),
+                          velocity=put_sharded(state.updater.velocity)),
+                      step=jax.device_put(state.step, rep))
 
 
 def param_pspecs(params, mesh: Mesh, tp_axis: str = "tp"):
@@ -323,7 +419,6 @@ def make_averaging_round(conf: MultiLayerConfiguration, mesh: Mesh,
         # the carry becomes dp-varying after one step (per-shard RNG fold,
         # masked gates); mark the invariant inits as varying so the
         # check_vma pass can type the scan with checking ON
-        from deeplearning4j_tpu.parallel.sequence import _as_varying
         vary = lambda t: jax.tree_util.tree_map(  # noqa: E731
             lambda a: _as_varying(a, axis), t)
         (params, upd, _), scores = jax.lax.scan(
