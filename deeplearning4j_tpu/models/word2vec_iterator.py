@@ -15,8 +15,30 @@ from typing import List, Optional, Sequence
 
 import numpy as np
 
+import logging
+
 from deeplearning4j_tpu.datasets.dataset import DataSet
-from deeplearning4j_tpu.text.windows import Window, window_features, windows
+from deeplearning4j_tpu.text.windows import (Window, string_with_labels,
+                                             window_features, windows)
+
+log = logging.getLogger(__name__)
+
+
+def _windows_to_dataset(take, vec, n_labels: int, label_index) -> DataSet:
+    """Featurize labeled windows: concatenated w2v vectors + one-hot
+    labels (shared by the iterator and the fetcher).  Raises ValueError
+    for a window label outside the label set."""
+    feats = np.stack([
+        window_features(w, vec.vector, vec.vector_length) for w in take])
+    y = np.zeros((len(take), n_labels), np.float32)
+    for i, w in enumerate(take):
+        idx = label_index.get(w.label)
+        if idx is None:
+            raise ValueError(
+                f"window label {w.label!r} not in labels "
+                f"{sorted(label_index)}")
+        y[i, idx] = 1.0
+    return DataSet(feats, y)
 
 
 class Word2VecDataSetIterator:
@@ -78,17 +100,8 @@ class Word2VecDataSetIterator:
         if not self._cache:
             return None
         take, self._cache = self._cache[:num], self._cache[num:]
-        feats = np.stack([
-            window_features(w, self.vec.vector, self.vec.vector_length)
-            for w in take])
-        y = np.zeros((len(take), len(self.labels)), np.float32)
-        for i, w in enumerate(take):
-            idx = self._label_index.get(w.label)
-            if idx is None:
-                raise ValueError(
-                    f"window label {w.label!r} not in labels {self.labels}")
-            y[i, idx] = 1.0
-        return DataSet(feats, y)
+        return _windows_to_dataset(take, self.vec, len(self.labels),
+                                   self._label_index)
 
     def __iter__(self):
         self.reset()
@@ -97,3 +110,91 @@ class Word2VecDataSetIterator:
             if ds is None:
                 return
             yield ds
+
+
+class Word2VecDataFetcher:
+    """`Word2VecDataFetcher.java` parity: walk text files under `path`
+    whose sentences carry inline `<LABEL> ... </LABEL>` span markup
+    (ContextLabelRetriever format), cut every span into word windows
+    featurized by the trained w2v vectors, and serve them as one DataSet
+    with one-hot span labels.  Unlabeled runs carry "NONE" — include it
+    in `labels` if such runs should train."""
+
+    def __init__(self, vec, path: str, labels: Sequence[str],
+                 window: Optional[int] = None):
+        import os
+
+        self.vec = vec
+        self.path = os.fspath(path)
+        self.labels = list(labels)
+        self.window = window or getattr(vec, "window", 5)
+        self._label_index = {l: i for i, l in enumerate(self.labels)}
+        self.cursor = 0
+        self._windows: List[Window] = []
+        self._load()
+
+    def _files(self) -> List[str]:
+        import os
+
+        if os.path.isfile(self.path):
+            return [self.path]
+        out = []
+        for d, _, files in sorted(os.walk(self.path)):
+            out.extend(os.path.join(d, f) for f in sorted(files))
+        return out
+
+    def _load(self) -> None:
+        from deeplearning4j_tpu.text.tokenization import (
+            DefaultTokenizerFactory)
+
+        factory = DefaultTokenizerFactory()
+        for fp in self._files():
+            with open(fp, "r", encoding="utf-8", errors="replace") as f:
+                for line in f:
+                    if not line.strip():
+                        continue
+                    try:
+                        _, spans = string_with_labels(line.strip(), factory)
+                    except ValueError as e:
+                        # a non-corpus file (README, HTML) swept up by the
+                        # directory walk must not abort the whole load
+                        log.warning("skipping malformed line in %s: %s",
+                                    fp, e)
+                        continue
+                    for label, toks in spans:
+                        if label != "NONE" and label not in self._label_index:
+                            raise ValueError(
+                                f"markup label {label!r} in {fp} not in "
+                                f"labels {self.labels}")
+                        if label not in self._label_index:
+                            continue  # NONE runs with no NONE class
+                        for w in windows(toks, self.window):
+                            w.label = label
+                            self._windows.append(w)
+
+    # -- DataSetFetcher contract ------------------------------------------
+    def total_examples(self) -> int:
+        return len(self._windows)
+
+    def input_columns(self) -> int:
+        return self.window * self.vec.vector_length
+
+    def total_outcomes(self) -> int:
+        return len(self.labels)
+
+    def reset(self) -> None:
+        self.cursor = 0
+
+    def has_more(self) -> bool:
+        return self.cursor < len(self._windows)
+
+    def fetch(self, num_examples: int) -> Optional[DataSet]:
+        if num_examples <= 0:
+            raise ValueError(f"num_examples must be positive, "
+                             f"got {num_examples}")
+        take = self._windows[self.cursor:self.cursor + num_examples]
+        if not take:
+            return None
+        self.cursor += len(take)
+        return _windows_to_dataset(take, self.vec, len(self.labels),
+                                   self._label_index)

@@ -296,3 +296,66 @@ def test_rntn_eval_confusion():
     assert ev.evaluation.confusion.total() == 2  # two non-leaf nodes
     assert ev.accuracy() >= 0.5
     assert "Accuracy" in ev.stats() or "accuracy" in ev.stats().lower()
+
+
+def test_word2vec_data_fetcher(tmp_path):
+    """`Word2VecDataFetcher.java` parity: labeled-markup text files ->
+    w2v-featurized window DataSets with one-hot span labels."""
+    import numpy as np
+
+    from deeplearning4j_tpu.models.word2vec import Word2Vec
+    from deeplearning4j_tpu.models.word2vec_iterator import (
+        Word2VecDataFetcher)
+
+    (tmp_path / "a.txt").write_text(
+        "the <PER> john smith </PER> visited <LOC> paris </LOC>\n"
+        "<PER> mary </PER> stayed home\n")
+    corpus = [["the", "john", "smith", "visited", "paris"],
+              ["mary", "stayed", "home"]]
+    w2v = Word2Vec(vector_length=6, window=3, negative=2,
+                   min_word_frequency=1, epochs=1, seed=0,
+                   batch_size=16).fit(corpus)
+    f = Word2VecDataFetcher(w2v, str(tmp_path), ["NONE", "PER", "LOC"],
+                            window=3)
+    # spans: NONE[the](1) PER[john,smith](2) NONE[visited](1) LOC[paris](1)
+    #        PER[mary](1) NONE[stayed,home](2) -> 8 windows
+    assert f.total_examples() == 8
+    assert f.input_columns() == 18 and f.total_outcomes() == 3
+    ds = f.fetch(5)
+    assert ds.features.shape == (5, 18) and ds.labels.shape == (5, 3)
+    assert np.allclose(ds.labels.sum(axis=1), 1.0)
+    # the two PER windows of sentence 1 are rows 1-2
+    assert ds.labels[1, 1] == 1.0 and ds.labels[2, 1] == 1.0
+    rest = f.fetch(100)
+    assert len(rest.features) == 3 and not f.has_more()
+    assert f.fetch(1) is None
+    f.reset()
+    assert f.has_more() and len(f.fetch(100).features) == 8
+
+
+def test_word2vec_data_fetcher_guards(tmp_path):
+    """Unknown markup labels raise; malformed non-corpus lines are
+    skipped with a warning; fetch(0) raises."""
+    import pytest
+
+    from deeplearning4j_tpu.models.word2vec import Word2Vec
+    from deeplearning4j_tpu.models.word2vec_iterator import (
+        Word2VecDataFetcher)
+
+    w2v = Word2Vec(vector_length=4, window=3, negative=2,
+                   min_word_frequency=1, epochs=1, seed=0,
+                   batch_size=8).fit([["a", "b", "c"]])
+    d = tmp_path / "c1"
+    d.mkdir()
+    (d / "good.txt").write_text("<PER> a </PER> b\n")
+    (d / "README.html").write_text("some </b> broken markup\n")
+    f = Word2VecDataFetcher(w2v, str(d), ["NONE", "PER"], window=3)
+    assert f.total_examples() == 2  # PER[a] + NONE[b]; html line skipped
+    with pytest.raises(ValueError, match="num_examples"):
+        f.fetch(0)
+
+    d2 = tmp_path / "c2"
+    d2.mkdir()
+    (d2 / "typo.txt").write_text("<PERSON> a </PERSON>\n")
+    with pytest.raises(ValueError, match="PERSON"):
+        Word2VecDataFetcher(w2v, str(d2), ["NONE", "PER"], window=3)
