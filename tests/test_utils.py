@@ -188,3 +188,39 @@ class TestParallelization:
 
         with pytest.raises(RuntimeError, match="task failed"):
             run_in_parallel([lambda: 1, boom, lambda: 2])
+
+
+class TestArchive:
+    def test_all_formats_roundtrip(self, tmp_path):
+        """`util/ArchiveUtils.unzipFileTo` parity: tar.gz / zip / gz all
+        extract into the target dir; unknown formats raise."""
+        import gzip
+        import tarfile
+        import zipfile
+
+        from deeplearning4j_tpu.utils.archive import unzip_file_to
+
+        src = tmp_path / "payload.txt"
+        src.write_text("hello archives")
+
+        tgz = tmp_path / "a.tar.gz"
+        with tarfile.open(tgz, "w:gz") as t:
+            t.add(src, arcname="inner/payload.txt")
+        unzip_file_to(str(tgz), str(tmp_path / "out_tgz"))
+        assert (tmp_path / "out_tgz/inner/payload.txt").read_text() \
+            == "hello archives"
+
+        zf = tmp_path / "a.zip"
+        with zipfile.ZipFile(zf, "w") as z:
+            z.write(src, "z/payload.txt")
+        unzip_file_to(str(zf), str(tmp_path / "out_zip"))
+        assert (tmp_path / "out_zip/z/payload.txt").exists()
+
+        gz = tmp_path / "solo.txt.gz"
+        with gzip.open(gz, "wb") as g:
+            g.write(b"gz body")
+        unzip_file_to(str(gz), str(tmp_path / "out_gz"))
+        assert (tmp_path / "out_gz/solo.txt").read_bytes() == b"gz body"
+
+        with pytest.raises(ValueError, match="unsupported"):
+            unzip_file_to(str(src), str(tmp_path / "nope"))
