@@ -161,3 +161,34 @@ def test_step_function_variants_applied():
     conf_g = NeuralNetConfiguration(step_function="gradient")
     np.testing.assert_allclose(
         np.asarray(apply_step(conf_g, x, d, 0.5)), [2.0, 1.0])
+
+
+def test_listener_dispatch_and_composition(caplog):
+    """ScoreIterationListener logs every N iterations; Composable fans
+    out; dispatch skips non-finite scores (reference
+    ScoreIterationListener.java:43-46 / IterationListener contract)."""
+    import logging
+
+    import numpy as np
+
+    from deeplearning4j_tpu.optimize.listeners import (
+        ComposableIterationListener, IterationListener,
+        ScoreIterationListener, dispatch)
+
+    seen = []
+
+    class Recorder(IterationListener):
+        def iteration_done(self, model, iteration, score):
+            seen.append((iteration, score))
+
+    rec = Recorder()
+    combo = ComposableIterationListener(
+        [ScoreIterationListener(print_iterations=2), rec])
+    scores = np.array([3.0, np.nan, 1.0, np.inf, 0.5])
+    with caplog.at_level(logging.INFO, logger="deeplearning4j_tpu"):
+        dispatch([combo], model=None, scores=scores)
+    # nan/inf iterations skipped; recorder saw the finite ones
+    assert seen == [(0, 3.0), (2, 1.0), (4, 0.5)]
+    # the score logger printed for iterations 0, 2, 4 (every 2nd)
+    assert sum("Score at iteration" in r.getMessage()
+               for r in caplog.records) == 3
