@@ -163,19 +163,27 @@ def cmd_train(args) -> int:
         net = MultiLayerNetwork(conf).init()
         deep_ae = (getattr(args, "zoo", None) or "").split(":")[0] \
             == "deep_autoencoder"
-        for _ in range(epochs):
-            if deep_ae:
-                # pretrain -> unroll decoder from the pretrained encoder
-                # -> reconstruction finetune (Hinton's recipe)
-                from deeplearning4j_tpu.models.zoo import (
-                    fit_deep_autoencoder)
+        if deep_ae:
+            # Hinton's recipe: pretrain + decoder unroll happen ONCE —
+            # re-running them per epoch would overwrite the previous
+            # epoch's finetuned decoder with transposed encoder weights;
+            # only the reconstruction finetune repeats
+            from deeplearning4j_tpu.models.zoo import fit_deep_autoencoder
 
-                fit_deep_autoencoder(net, data.features)
-            else:
+            fit_deep_autoencoder(net, data.features)
+            for _ in range(epochs - 1):
+                net.finetune(data.features, data.features)
+        else:
+            for _ in range(epochs):
                 net.fit(data.features, data.labels)
 
     train_seconds = _time.perf_counter() - t_train
-    score = net.score(data.features, data.labels)
+    deep_ae = (getattr(args, "zoo", None) or "").split(":")[0] \
+        == "deep_autoencoder"
+    # a reconstruction head's output width is n_in: score against the
+    # inputs, not the (differently-shaped) labels
+    score = net.score(data.features,
+                      data.features if deep_ae else data.labels)
     checkpoint.save(args.output, net.params, conf=conf,
                     metadata={"score": score, "input": args.input})
     print(json.dumps({"saved": args.output, "score": score,

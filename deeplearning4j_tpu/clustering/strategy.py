@@ -152,6 +152,20 @@ class ConvergenceCondition(ClusteringAlgorithmCondition):
         return info.point_location_change / max(n_points, 1) < self.rate
 
 
+class IterationCountMultipleOfCondition(ClusteringAlgorithmCondition):
+    """True on every n-th iteration (what the fluent name
+    `optimizeWhenIterationCountMultipleOf` promises; the reference's own
+    implementation reuses iterationCountGreaterThan, firing on EVERY
+    iteration past n — a quirk, not a behavior worth copying)."""
+
+    def __init__(self, n: int):
+        self.n = max(1, n)
+
+    def is_satisfied(self, history: IterationHistory) -> bool:
+        return (history.iteration_count > 0
+                and history.iteration_count % self.n == 0)
+
+
 class VarianceVariationCondition(ClusteringAlgorithmCondition):
     """True when the relative change of the point-to-center distance
     variance stayed below `threshold` for `period` consecutive
@@ -276,7 +290,7 @@ class OptimisationStrategy(BaseClusteringStrategy):
         return self
 
     def optimize_when_iteration_count_multiple_of(self, n: int):
-        self._application_condition = FixedIterationCountCondition(n)
+        self._application_condition = IterationCountMultipleOfCondition(n)
         return self
 
     def optimize_when_point_distribution_variation_rate_less_than(
@@ -345,12 +359,19 @@ class BaseClusteringAlgorithm:
             empties = np.where(counts == 0)[0]
             if len(empties):
                 # FIXED_CLUSTER_COUNT restores k by splitting the most
-                # spread-out clusters into the empty slots
-                order = np.argsort(-np.asarray(stats["avg_dist"]))
+                # spread-out clusters into the empty slots; a source must
+                # have >1 member AND nonzero spread (splitting a cluster
+                # of identical points re-creates the same center), and
+                # repair that makes no progress must not count as applied
+                # (it would defeat the termination condition)
+                order = [int(s) for s in
+                         np.argsort(-np.asarray(stats["avg_dist"]))
+                         if counts[s] > 1
+                         and np.max(dist[assign == s], initial=0.0) > 0]
                 for slot, source in zip(empties, order):
                     centers = self._split_cluster(
-                        centers, x, assign, dist, int(source), int(slot))
-                applied = True
+                        centers, x, assign, dist, source, int(slot))
+                    applied = True
         if (self.strategy.is_optimization_defined()
                 and self.history.iteration_count != 0
                 and self.strategy.is_optimization_applicable_now(

@@ -133,44 +133,36 @@ class Word2VecDataFetcher:
         self._windows: List[Window] = []
         self._load()
 
-    def _files(self) -> List[str]:
-        import os
-
-        if os.path.isfile(self.path):
-            return [self.path]
-        out = []
-        for d, _, files in sorted(os.walk(self.path)):
-            out.extend(os.path.join(d, f) for f in sorted(files))
-        return out
-
     def _load(self) -> None:
+        from deeplearning4j_tpu.text.sentence_iterator import (
+            DocumentIterator)
         from deeplearning4j_tpu.text.tokenization import (
             DefaultTokenizerFactory)
 
         factory = DefaultTokenizerFactory()
-        for fp in self._files():
-            with open(fp, "r", encoding="utf-8", errors="replace") as f:
-                for line in f:
-                    if not line.strip():
-                        continue
-                    try:
-                        _, spans = string_with_labels(line.strip(), factory)
-                    except ValueError as e:
-                        # a non-corpus file (README, HTML) swept up by the
-                        # directory walk must not abort the whole load
-                        log.warning("skipping malformed line in %s: %s",
-                                    fp, e)
-                        continue
-                    for label, toks in spans:
-                        if label != "NONE" and label not in self._label_index:
-                            raise ValueError(
-                                f"markup label {label!r} in {fp} not in "
-                                f"labels {self.labels}")
-                        if label not in self._label_index:
-                            continue  # NONE runs with no NONE class
-                        for w in windows(toks, self.window):
-                            w.label = label
-                            self._windows.append(w)
+        docs = DocumentIterator(self.path)  # shared recursive sorted walk
+        for text in docs:
+            fp = docs.current_path()
+            for line in text.splitlines():
+                if not line.strip():
+                    continue
+                try:
+                    _, spans = string_with_labels(line.strip(), factory)
+                except ValueError as e:
+                    # a non-corpus file (README, HTML) swept up by the
+                    # directory walk must not abort the whole load
+                    log.warning("skipping malformed line in %s: %s", fp, e)
+                    continue
+                for label, toks in spans:
+                    if label != "NONE" and label not in self._label_index:
+                        raise ValueError(
+                            f"markup label {label!r} in {fp} not in "
+                            f"labels {self.labels}")
+                    if label not in self._label_index:
+                        continue  # NONE runs with no NONE class
+                    for w in windows(toks, self.window):
+                        w.label = label
+                        self._windows.append(w)
 
     # -- DataSetFetcher contract ------------------------------------------
     def total_examples(self) -> int:

@@ -187,6 +187,11 @@ class DocumentIterator:
         with open(path, "r", encoding="utf-8", errors="replace") as f:
             return f.read()
 
+    def current_path(self) -> str:
+        """Path of the most recently returned document (cursor-following,
+        like the label-aware iterators' current_label)."""
+        return self._paths[max(0, self._i - 1)]
+
     def __iter__(self):
         self.reset()
         while self.has_next():

@@ -249,3 +249,37 @@ class TestSpTree:
                 (0.4 / 5.0) * np.array([0.0, -2.0])
         assert np.allclose(pos_f[0], want0)
         assert np.allclose(pos_f[1], 0) and np.allclose(pos_f[2], 0)
+
+
+class TestStrategyFrameworkFixes:
+    def test_multiple_of_condition_fires_periodically(self):
+        """optimize_when_iteration_count_multiple_of(n) fires on every
+        n-th iteration only — not on every iteration past n (the
+        reference's own implementation quirk, deliberately not copied)."""
+        from deeplearning4j_tpu.clustering.strategy import (
+            IterationCountMultipleOfCondition, IterationHistory,
+            IterationInfo)
+
+        cond = IterationCountMultipleOfCondition(3)
+        h = IterationHistory()
+        fired = []
+        for i in range(1, 10):
+            h.infos.append(IterationInfo(index=i - 1,
+                                         point_location_change=0,
+                                         distance_variance=1.0,
+                                         counts=np.zeros(2)))
+            fired.append(cond.is_satisfied(h))
+        assert fired == [False, False, True, False, False, True,
+                         False, False, True]
+
+    def test_degenerate_identical_points_terminate(self):
+        """All points identical, k > 1: empty-cluster repair has no
+        splittable source, must not mark strategy_applied forever — the
+        fixed-iteration condition terminates on time."""
+        from deeplearning4j_tpu.clustering import KMeansClustering
+
+        x = np.ones((12, 3), np.float32)
+        algo = KMeansClustering.setup(3, max_iterations=5, seed=0)
+        cs = algo.apply_to(x)
+        assert algo.history.iteration_count <= 6
+        assert len(cs.clusters) == 3
