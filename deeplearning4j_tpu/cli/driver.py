@@ -128,6 +128,8 @@ def cmd_train(args) -> int:
 
     props = _parse_properties(args.properties)
     epochs = int(props.get("epochs", "1"))
+    deep_ae = (getattr(args, "zoo", None) or "").split(":")[0] \
+        == "deep_autoencoder"
     import time as _time
     t_train = _time.perf_counter()
     n_trained = data.num_examples() * epochs
@@ -161,25 +163,22 @@ def cmd_train(args) -> int:
         trainer.fit(data.batch_by(batch), epochs=epochs)
     else:
         net = MultiLayerNetwork(conf).init()
-        deep_ae = (getattr(args, "zoo", None) or "").split(":")[0] \
-            == "deep_autoencoder"
-        if deep_ae:
+        if deep_ae and epochs > 0:
             # Hinton's recipe: pretrain + decoder unroll happen ONCE —
             # re-running them per epoch would overwrite the previous
             # epoch's finetuned decoder with transposed encoder weights;
-            # only the reconstruction finetune repeats
+            # only the reconstruction finetune repeats (epochs=0 still
+            # means "no training", matching the other models)
             from deeplearning4j_tpu.models.zoo import fit_deep_autoencoder
 
             fit_deep_autoencoder(net, data.features)
             for _ in range(epochs - 1):
                 net.finetune(data.features, data.features)
-        else:
+        elif not deep_ae:
             for _ in range(epochs):
                 net.fit(data.features, data.labels)
 
     train_seconds = _time.perf_counter() - t_train
-    deep_ae = (getattr(args, "zoo", None) or "").split(":")[0] \
-        == "deep_autoencoder"
     # a reconstruction head's output width is n_in: score against the
     # inputs, not the (differently-shaped) labels
     score = net.score(data.features,

@@ -140,29 +140,32 @@ class Word2VecDataFetcher:
             DefaultTokenizerFactory)
 
         factory = DefaultTokenizerFactory()
-        docs = DocumentIterator(self.path)  # shared recursive sorted walk
-        for text in docs:
-            fp = docs.current_path()
-            for line in text.splitlines():
-                if not line.strip():
-                    continue
-                try:
-                    _, spans = string_with_labels(line.strip(), factory)
-                except ValueError as e:
-                    # a non-corpus file (README, HTML) swept up by the
-                    # directory walk must not abort the whole load
-                    log.warning("skipping malformed line in %s: %s", fp, e)
-                    continue
-                for label, toks in spans:
-                    if label != "NONE" and label not in self._label_index:
-                        raise ValueError(
-                            f"markup label {label!r} in {fp} not in "
-                            f"labels {self.labels}")
-                    if label not in self._label_index:
-                        continue  # NONE runs with no NONE class
-                    for w in windows(toks, self.window):
-                        w.label = label
-                        self._windows.append(w)
+        # DocumentIterator supplies the recursive sorted walk; contents
+        # are streamed line-by-line here (constant memory on big files)
+        for fp in DocumentIterator(self.path).paths():
+            with open(fp, "r", encoding="utf-8", errors="replace") as f:
+                for line in f:
+                    if not line.strip():
+                        continue
+                    try:
+                        _, spans = string_with_labels(line.strip(), factory)
+                    except ValueError as e:
+                        # a non-corpus file (README, HTML) swept up by the
+                        # directory walk must not abort the whole load
+                        log.warning("skipping malformed line in %s: %s",
+                                    fp, e)
+                        continue
+                    for label, toks in spans:
+                        if (label != "NONE"
+                                and label not in self._label_index):
+                            raise ValueError(
+                                f"markup label {label!r} in {fp} not in "
+                                f"labels {self.labels}")
+                        if label not in self._label_index:
+                            continue  # NONE runs with no NONE class
+                        for w in windows(toks, self.window):
+                            w.label = label
+                            self._windows.append(w)
 
     # -- DataSetFetcher contract ------------------------------------------
     def total_examples(self) -> int:

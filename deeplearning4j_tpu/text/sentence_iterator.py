@@ -192,6 +192,11 @@ class DocumentIterator:
         like the label-aware iterators' current_label)."""
         return self._paths[max(0, self._i - 1)]
 
+    def paths(self) -> List[str]:
+        """The discovered document paths (recursive sorted walk), for
+        consumers that stream file contents themselves."""
+        return list(self._paths)
+
     def __iter__(self):
         self.reset()
         while self.has_next():
