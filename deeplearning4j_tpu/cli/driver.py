@@ -128,8 +128,22 @@ def cmd_train(args) -> int:
 
     props = _parse_properties(args.properties)
     epochs = int(props.get("epochs", "1"))
-    deep_ae = (getattr(args, "zoo", None) or "").split(":")[0] \
-        == "deep_autoencoder"
+    # reconstruction nets are detected by MECHANISM (output loss), not by
+    # the --zoo spelling, so a deep-AE conf loaded via --model JSON gets
+    # the same treatment: fit/score against the inputs, and Hinton's
+    # pretrain->unroll->finetune recipe when it's a pretrainable AE stack
+    from deeplearning4j_tpu.nd.losses import LossFunction
+    out_lf = conf.conf(conf.n_layers - 1).loss_function
+    reconstruction = (LossFunction(str(out_lf))
+                      == LossFunction.RECONSTRUCTION_CROSSENTROPY)
+    deep_ae = reconstruction and conf.pretrain and any(
+        LayerType(str(c.layer_type)) == LayerType.AUTOENCODER
+        for c in conf.confs)
+    if args.runtime == "mesh" and (deep_ae or conf.pretrain):
+        raise SystemExit(
+            "pretraining workflows (dbn/deep_autoencoder) need "
+            "--runtime local: the mesh data-parallel step is "
+            "gradient-only and would silently skip layer-wise pretraining")
     import time as _time
     t_train = _time.perf_counter()
     n_trained = data.num_examples() * epochs
@@ -175,14 +189,17 @@ def cmd_train(args) -> int:
             for _ in range(epochs - 1):
                 net.finetune(data.features, data.features)
         elif not deep_ae:
+            # plain reconstruction confs (no AE pretrain stack) still
+            # train against the inputs
+            target = data.features if reconstruction else data.labels
             for _ in range(epochs):
-                net.fit(data.features, data.labels)
+                net.fit(data.features, target)
 
     train_seconds = _time.perf_counter() - t_train
     # a reconstruction head's output width is n_in: score against the
     # inputs, not the (differently-shaped) labels
     score = net.score(data.features,
-                      data.features if deep_ae else data.labels)
+                      data.features if reconstruction else data.labels)
     checkpoint.save(args.output, net.params, conf=conf,
                     metadata={"score": score, "input": args.input})
     print(json.dumps({"saved": args.output, "score": score,

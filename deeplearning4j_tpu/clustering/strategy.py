@@ -315,10 +315,13 @@ class BaseClusteringAlgorithm:
     strategy} until the termination condition fires."""
 
     def __init__(self, strategy: BaseClusteringStrategy, seed: int = 0):
-        if strategy.termination_condition is None:
-            strategy.end_when_iteration_count_equals(
-                OptimisationStrategy.DEFAULT_ITERATIONS)
         self.strategy = strategy
+        # default termination lives on the ALGORITHM — writing it into
+        # the (possibly shared) strategy object would change the stopping
+        # behavior of other algorithms built from the same strategy
+        self._termination = (strategy.termination_condition
+                             or FixedIterationCountCondition(
+                                 OptimisationStrategy.DEFAULT_ITERATIONS))
         self.seed = seed
         self.history = IterationHistory()
 
@@ -414,13 +417,14 @@ class BaseClusteringAlgorithm:
         xj = jnp.asarray(x)
         assign = jnp.zeros((len(pts),), jnp.int32)
         self.history = IterationHistory()
-        cond = self.strategy.termination_condition
+        cond = self.strategy.termination_condition or self._termination
 
         # hard backstop: a strategy that fires every iteration (e.g. an
         # unsatisfiable optimisation target) must not loop forever — the
         # reference has no such guard and can spin; 1000 >> any real run
         while ((not cond.is_satisfied(self.history)
-                or self.history.most_recent.strategy_applied)
+                or (self.history.most_recent is not None
+                    and self.history.most_recent.strategy_applied))
                and self.history.iteration_count < 1000):
             centers, assign, dist, stats = _iterate(
                 xj, centers, assign, self.strategy.distance_fn)

@@ -140,8 +140,11 @@ class Word2VecDataFetcher:
             DefaultTokenizerFactory)
 
         factory = DefaultTokenizerFactory()
-        # DocumentIterator supplies the recursive sorted walk; contents
-        # are streamed line-by-line here (constant memory on big files)
+        # DocumentIterator supplies the recursive sorted walk; file
+        # contents are read line-by-line (no whole-file strings), though
+        # the RESULT — every labeled window of the corpus — is held in
+        # RAM like the reference fetcher; stream from DiskInvertedIndex
+        # for corpora beyond memory
         for fp in DocumentIterator(self.path).paths():
             with open(fp, "r", encoding="utf-8", errors="replace") as f:
                 for line in f:

@@ -215,12 +215,14 @@ class DiskInvertedIndex:
         return DiskDocs(self)
 
     # -- persistence -------------------------------------------------------
-    def save(self, path: Optional[str] = None) -> None:
-        """Write the manifest (documents are already durable in the log)."""
+    def save(self) -> None:
+        """Write the manifest (documents are already durable in the log).
+        Always lands at `directory/index.json` — the only location
+        `load`/`__init__` consult."""
         self._flush()
         log_size = (os.path.getsize(self._log_path)
                     if os.path.exists(self._log_path) else 0)
-        with open(path or self._meta_path, "w") as f:
+        with open(self._meta_path, "w") as f:
             json.dump({"version": 1, "log_size": log_size,
                        "offsets": self._offsets,
                        "postings": self._postings}, f)

@@ -18,6 +18,10 @@ END = "</s>"
 
 _BEGIN_LABEL = re.compile(r"^<([A-Za-z0-9_]+)>$")
 _END_LABEL = re.compile(r"^</([A-Za-z0-9_]+)>$")
+# a tag FRAGMENT embedded in a longer token (`<PER>john`) means the
+# markup wasn't whitespace-delimited — silently treating it as text
+# would leak tag characters into the training tokens
+_EMBEDDED_TAG = re.compile(r"</?[A-Za-z0-9_]+>")
 
 
 def string_with_labels(sentence: str, tokenizer_factory=None
@@ -68,6 +72,10 @@ def string_with_labels(sentence: str, tokenizer_factory=None
             close_run(curr_label)
             curr_label = None
         else:
+            if _EMBEDDED_TAG.search(token):
+                raise ValueError(
+                    f"label markup must be whitespace-delimited; found "
+                    f"embedded tag in token {token!r}")
             curr.append(token)
     if curr_label is not None:
         raise ValueError(f"unclosed label <{curr_label}>")

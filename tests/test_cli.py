@@ -163,3 +163,33 @@ def test_cli_char_transformer_trains_with_adam(tmp_path):
                "--output", out])
     assert rc == 0
     assert os.path.isdir(out)
+
+
+def test_mesh_runtime_rejects_pretrain_workflows(tmp_path):
+    """--runtime mesh with a pretrain config must refuse loudly: the dp
+    step is gradient-only and would silently skip CD-k/AE pretraining."""
+    import pytest
+
+    from deeplearning4j_tpu.cli.driver import main
+
+    with pytest.raises(SystemExit, match="pretraining"):
+        main(["train", "--input", "iris:", "--zoo", "dbn:hidden=8x4",
+              "--runtime", "mesh", "--output", str(tmp_path / "x")])
+
+
+def test_reconstruction_conf_via_model_json(tmp_path):
+    """A deep-AE conf loaded through --model JSON (not --zoo) is detected
+    by MECHANISM (reconstruction loss + AE pretrain stack): trains and
+    scores against the inputs instead of crashing on label width."""
+    import json as json_mod
+
+    from deeplearning4j_tpu.cli.driver import main
+    from deeplearning4j_tpu.models.zoo import deep_autoencoder
+
+    conf = deep_autoencoder(4, hidden=(3,), iterations=3,
+                            finetune_iterations=5)
+    cj = tmp_path / "conf.json"
+    cj.write_text(conf.to_json())
+    rc = main(["train", "--input", "iris:", "--model", str(cj),
+               "--output", str(tmp_path / "dae"), "--scale-01"])
+    assert rc == 0

@@ -283,3 +283,22 @@ class TestStrategyFrameworkFixes:
         cs = algo.apply_to(x)
         assert algo.history.iteration_count <= 6
         assert len(cs.clusters) == 3
+
+
+def test_strategy_shared_between_algorithms_not_mutated():
+    """BaseClusteringAlgorithm must not write its default termination
+    into a shared strategy object; a condition satisfiable on an empty
+    history must not crash the loop."""
+    from deeplearning4j_tpu.clustering import (
+        BaseClusteringAlgorithm, FixedClusterCountStrategy)
+
+    strat = FixedClusterCountStrategy.setup(2)
+    BaseClusteringAlgorithm.setup(strat)
+    assert strat.termination_condition is None  # caller's object untouched
+
+    strat0 = FixedClusterCountStrategy.setup(2) \
+        .end_when_iteration_count_equals(0)
+    algo = BaseClusteringAlgorithm.setup(strat0, seed=0)
+    cs = algo.apply_to(_two_blobs())  # immediate termination, no crash
+    assert algo.history.iteration_count == 0
+    assert len(cs.clusters) == 2

@@ -279,3 +279,14 @@ def test_windows():
     m = moving_window_matrix(np.arange(5), 3)
     assert m.shape == (3, 3)
     np.testing.assert_array_equal(m[0], [0, 1, 2])
+
+
+def test_embedded_markup_tags_rejected():
+    """Non-whitespace-delimited markup (<PER>john) raises instead of
+    silently leaking tag text into training tokens."""
+    import pytest
+
+    from deeplearning4j_tpu.text.windows import string_with_labels
+
+    with pytest.raises(ValueError, match="whitespace-delimited"):
+        string_with_labels("the <PER>john smith</PER> went home")
