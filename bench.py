@@ -490,6 +490,52 @@ def bench_transformer_mfu(devs) -> None:
 
 
 # ---------------------------------------------------------------------------
+# step cache — steady-state single-chip fit() throughput, compile excluded
+# ---------------------------------------------------------------------------
+
+def bench_step_cache(devs) -> None:
+    """Single-chip `MultiLayerNetwork.fit` through the compiled train-step
+    cache (optimize/step_cache.py): the warm-up batch pays the one compile,
+    the timed loop is pure cache hits, so samples/sec is steady-state
+    execution with compile time excluded.  The cache's compile-seconds
+    total goes out as its own metric line so the perf trajectory tracks
+    compile overhead separately from throughput."""
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.models.zoo import mlp
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+    batch, warmup, batches = (32, 1, 4) if SMALL else (1024, 2, 30)
+    conf = mlp(784, [512, 512], 10)
+    net = MultiLayerNetwork(conf, seed=0).init()
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.rand(batch, 784), jnp.float32)
+    y = jnp.asarray(np.eye(10, dtype=np.float32)[rng.randint(0, 10, batch)])
+
+    tw = time.perf_counter()
+    for _ in range(warmup):  # first fit compiles; the rest prove the hits
+        net.fit(x, y)
+    _host_sync(net.params)
+    warm_s = time.perf_counter() - tw
+
+    t0 = time.perf_counter()
+    for _ in range(batches):
+        net.fit(x, y)
+    _host_sync(net.params)
+    dt = time.perf_counter() - t0
+
+    st = net.step_cache.stats
+    _emit("step-cache steady-state fit samples/sec", batches * batch / dt,
+          "samples/sec", None,
+          cache_hits=st.hits, cache_misses=st.misses,
+          solver_iterations_per_fit=conf.conf(conf.n_layers - 1).num_iterations,
+          warmup_seconds=round(warm_s, 1))
+    _emit("step-cache compile seconds total", st.total_compile_seconds,
+          "seconds", None, entries=len(st.compile_seconds),
+          baseline_note="one-time cost; steady-state line above excludes it")
+
+
+# ---------------------------------------------------------------------------
 # north_star — LeNet-MNIST and the 4-layer char-LSTM end-to-end FROM THE CLI
 # ---------------------------------------------------------------------------
 
@@ -526,6 +572,7 @@ def bench_north_star_cli(devs) -> None:
               "samples/sec", info["examples_per_sec"] / 500.0,
               final_score=round(info["score"], 4),
               train_seconds=info["train_seconds"],
+              compile_seconds=info.get("compile_seconds"),
               baseline_note="one CLI command, end-to-end incl. compile; "
                             "assumed 500 samples/sec 2015 CPU-jblas")
 
@@ -550,6 +597,7 @@ def bench_north_star_cli(devs) -> None:
               "chars/sec", chars_per_sec / 1500.0,
               final_score=round(info["score"], 4),
               train_seconds=info["train_seconds"],
+              compile_seconds=info.get("compile_seconds"),
               baseline_note="one CLI command, end-to-end incl. compile; "
                             "assumed 1500 chars/sec 2015 CPU BPTT x4 layers")
 
@@ -560,7 +608,8 @@ def bench_north_star_cli(devs) -> None:
 # (timeout-shortened) run still captures the five baseline metrics.
 BENCHES = [bench_lenet, bench_char_lstm, bench_vgg_cifar10, bench_word2vec,
            bench_dp_allreduce,
-           bench_char_lstm4, bench_north_star_cli, bench_transformer_mfu]
+           bench_char_lstm4, bench_step_cache, bench_north_star_cli,
+           bench_transformer_mfu]
 BASELINE_FIVE = {"bench_lenet", "bench_char_lstm", "bench_vgg_cifar10",
                  "bench_word2vec", "bench_dp_allreduce"}
 

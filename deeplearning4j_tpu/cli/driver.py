@@ -191,9 +191,19 @@ def cmd_train(args) -> int:
         elif not deep_ae:
             # plain reconstruction confs (no AE pretrain stack) still
             # train against the inputs
-            target = data.features if reconstruction else data.labels
+            batch = int(props.get("batch", "0"))
             for _ in range(epochs):
-                net.fit(data.features, target)
+                if batch > 0:
+                    # mini-batch loop: each (conf, bucket-shape) pair
+                    # compiles ONE solver program in net.step_cache and
+                    # every further batch is a cache hit; the remainder
+                    # batch pads into the full-batch bucket
+                    for b in data.batch_by(batch):
+                        net.fit(b.features,
+                                b.features if reconstruction else b.labels)
+                else:
+                    net.fit(data.features,
+                            data.features if reconstruction else data.labels)
 
     train_seconds = _time.perf_counter() - t_train
     # a reconstruction head's output width is n_in: score against the
@@ -202,10 +212,14 @@ def cmd_train(args) -> int:
                       data.features if reconstruction else data.labels)
     checkpoint.save(args.output, net.params, conf=conf,
                     metadata={"score": score, "input": args.input})
+    cs = net.step_cache.stats  # mesh runtime bypasses it: zeros
     print(json.dumps({"saved": args.output, "score": score,
                       "train_seconds": round(train_seconds, 3),
                       "examples_per_sec": round(
-                          n_trained / max(train_seconds, 1e-9), 2)}))
+                          n_trained / max(train_seconds, 1e-9), 2),
+                      "compile_seconds": round(cs.total_compile_seconds, 3),
+                      "cache_hits": cs.hits,
+                      "cache_misses": cs.misses}))
     return 0
 
 

@@ -26,13 +26,18 @@ from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
 class CharLSTM:
     def __init__(self, hidden: int = 128, n_layers: int = 1,
                  seq_len: int = 32, lr: float = 0.1, iterations: int = 50,
-                 seed: int = 0):
+                 seed: int = 0, batch_size: Optional[int] = None):
         self.hidden = hidden
         self.n_layers = n_layers
         self.seq_len = seq_len
         self.lr = lr
         self.iterations = iterations
         self.seed = seed
+        # batch_size=None trains all windows as one batch; an int slices
+        # the windows into mini-batches that all reuse ONE compiled solver
+        # program via the network's step cache (the remainder slice pads
+        # into the same bucket)
+        self.batch_size = batch_size
         self.char_index: Dict[str, int] = {}
         self.chars: List[str] = []
         self.net: Optional[MultiLayerNetwork] = None
@@ -57,7 +62,15 @@ class CharLSTM:
         conf = char_lstm(v, hidden=self.hidden, n_layers=self.n_layers,
                          lr=self.lr, iterations=self.iterations)
         self.net = MultiLayerNetwork(conf, seed=self.seed).init()
-        self.net.fit(eye[xs], eye[ys])
+        x, y = eye[xs], eye[ys]
+        bs = self.batch_size
+        if bs and bs < n_win:
+            t = self.seq_len  # label rows are window-major blocks of T
+            for s in range(0, n_win, bs):
+                xb = x[s:s + bs]
+                self.net.fit(xb, y[s * t:(s + xb.shape[0]) * t])
+        else:
+            self.net.fit(x, y)
         return self
 
     # -- decoding plumbing

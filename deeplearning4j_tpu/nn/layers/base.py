@@ -36,6 +36,22 @@ def mixed_matmul(x, W, conf):
     return (x.astype(cd) @ W.astype(cd)).astype(W.dtype)
 
 
+def rows_broadcast(v, n_rows, dtype=None):
+    """Broadcast a feature vector v[F] over n_rows rows as `ones @ v[None]`
+    (a rank-1 gemm) rather than a plain numpy-style broadcast.
+
+    Value-identical (1.0 * v_j is exact), but the TRANSPOSE — the batch-dim
+    reduction autodiff emits for the broadcast's backward pass — lowers as a
+    gemm contraction, which XLA evaluates bit-identically whatever the batch
+    size.  A plain broadcast transposes to `reduce_sum` over the batch dim,
+    whose pairwise-split strategy is shape-dependent: a remainder batch
+    zero-padded into a larger bucket would then drift from the unpadded run
+    by ~1 ulp in bias / BN-affine gradients, breaking the step cache's
+    bit-for-bit padding guarantee."""
+    dt = dtype or v.dtype
+    return jnp.ones((n_rows, 1), dt) @ v[None, :].astype(dt)
+
+
 class DenseLayer:
     """f(x.W + b) with optional dropout/dropconnect."""
 
@@ -54,7 +70,10 @@ class DenseLayer:
         W = params["W"]
         if training and conf.drop_connect and key is not None:
             W = W * ndr.dropout_mask(key, 0.5, W.shape, W.dtype)
-        return mixed_matmul(x, W, conf) + params["b"]
+        z = mixed_matmul(x, W, conf)
+        if z.ndim == 2:  # gemm-broadcast the bias: pad-invariant bias grad
+            return z + rows_broadcast(params["b"], z.shape[0], z.dtype)
+        return z + params["b"]
 
     @staticmethod
     def forward(params, conf, x, key=None, training=False):
@@ -105,6 +124,18 @@ class BatchNormLayer:
         shards can psum them into GLOBAL-batch statistics."""
         axes = BatchNormLayer._feature_axes(x)
         xf = x.astype(jnp.float32)
+        if x.ndim == 2:
+            # express the batch-dim reductions as gemm contractions so the
+            # moments (and their grads) are bit-invariant to zero-pad rows
+            # — see `rows_broadcast` for why reduce_sum is not
+            if row_weights is None:
+                w1 = jnp.ones((1, x.shape[0]), jnp.float32)
+            else:
+                w1 = row_weights.reshape(1, -1).astype(jnp.float32)
+            s1 = (w1 @ xf)[0]
+            s2 = (w1 @ (xf * xf))[0]
+            cnt = (w1 @ jnp.ones((x.shape[0], 1), jnp.float32))[0, 0]
+            return s1, s2, cnt
         if row_weights is None:
             cnt = jnp.asarray(float(np.prod([x.shape[a] for a in axes])),
                               jnp.float32)
@@ -146,6 +177,14 @@ class BatchNormLayer:
             var = var[None, :, None, None]
             gamma = params["gamma"][None, :, None, None]
             beta = params["beta"][None, :, None, None]
+        elif x.ndim == 2:
+            # gemm-broadcast every feature vector (pad-invariant grads for
+            # gamma/beta and for whatever feeds mean/var — see rows_broadcast)
+            n = x.shape[0]
+            mean = rows_broadcast(mean, n, x.dtype)
+            var = rows_broadcast(var, n, x.dtype)
+            gamma = rows_broadcast(params["gamma"], n, x.dtype)
+            beta = rows_broadcast(params["beta"], n, x.dtype)
         else:
             gamma, beta = params["gamma"], params["beta"]
         xn = (x - mean) / jnp.sqrt(var + eps)

@@ -34,6 +34,7 @@ from deeplearning4j_tpu.nn.layers import get_layer
 from deeplearning4j_tpu.nn.layers.preprocessor import apply_preprocessor
 from deeplearning4j_tpu.optimize import solver as solver_mod
 from deeplearning4j_tpu.optimize.listeners import dispatch as dispatch_listeners
+from deeplearning4j_tpu.optimize.step_cache import TrainStepCache
 
 log = logging.getLogger("deeplearning4j_tpu")
 
@@ -252,6 +253,37 @@ def update_bn_ema(conf: MultiLayerConfiguration, params, x, axis=None,
     return tuple(new)
 
 
+def make_finetune_loss(conf: MultiLayerConfiguration, collect_bn: bool = False):
+    """Batched finetune loss `(params, x, y, w, key) -> (loss, stats)`.
+
+    Loss = row-weighted mean of `network_rowwise_loss` over the real rows
+    (w is the per-LABEL-row weight vector; pad rows carry 0) plus
+    `network_regularization`.  This is the ONE loss definition shared by
+    the compiled step-cache programs and the uncached comparison path, so
+    cached and uncached training match bit-for-bit; a full batch is just
+    w = ones.  stats is () unless collect_bn (then the raw BatchNorm
+    moments of this forward, for `update_bn_ema_from_stats`)."""
+
+    def loss_fn(params, x, y, w, key):
+        # feature-row weights from label-row weights (label rows may be
+        # B*T for sequence models)
+        ratio = w.shape[0] // x.shape[0]
+        wx = w.reshape(x.shape[0], ratio)[:, 0]
+        out = network_rowwise_loss(conf, params, x, y, key, training=True,
+                                   row_weights=wx,
+                                   return_bn_stats=collect_bn)
+        rows, stats = out if collect_bn else (out, ())
+        # dot, not sum(rows * w): a gemm contraction over the batch dim is
+        # bit-invariant to trailing zero-weight pad rows, while reduce_sum's
+        # pairwise split is shape-dependent (see layers.base.rows_broadcast)
+        loss = (jnp.dot(rows, w) / jnp.maximum(jnp.dot(w, jnp.ones_like(w)),
+                                               1.0)
+                + network_regularization(conf, params))
+        return loss, stats
+
+    return loss_fn
+
+
 def network_regularization(conf: MultiLayerConfiguration, params):
     """The regularization half of `network_loss` (L2 across layers + the
     output layer's L2/L1), as one scalar counted once per step."""
@@ -279,6 +311,12 @@ class MultiLayerNetwork:
         self.params: Optional[tuple] = None
         self.listeners: List = []
         self._bn_ema_fn = None
+        # compiled train-step cache: one AOT-compiled solver program per
+        # (conf, algo, batch shape), reused across every fit batch.
+        # use_step_cache=False restores the legacy retrace-per-batch path.
+        self.step_cache = TrainStepCache()
+        self.use_step_cache = True
+        self._bn_in_step = False  # did the last finetune advance BN EMA?
 
     # -- lifecycle ---------------------------------------------------------
     def _next_key(self):
@@ -353,9 +391,13 @@ class MultiLayerNetwork:
         def sc(p, key):
             return impl.pretrain_score(p, c, x, key)
 
-        objective = solver_mod.Objective(grad_and_score=gs, score=sc)
-        new_p, scores = solver_mod.optimize(objective, self.params[i], c,
-                                            self._next_key())
+        if self.use_step_cache:
+            new_p, scores = self.step_cache.pretrain(
+                c, i, impl, self.params[i], x, self._next_key())
+        else:
+            objective = solver_mod.Objective(grad_and_score=gs, score=sc)
+            new_p, scores = solver_mod.optimize(objective, self.params[i],
+                                                c, self._next_key())
         params = list(self.params)
         params[i] = new_p
         self.params = tuple(params)
@@ -375,12 +417,27 @@ class MultiLayerNetwork:
                 self.pretrain_layer(i, layer_in)
 
     def finetune(self, x, labels) -> None:
-        """Supervised end-to-end optimization (finetune/backprop parity)."""
+        """Supervised end-to-end optimization (finetune/backprop parity).
+
+        Default path: the compiled step cache — batch data enters the
+        solver program as jit arguments, so a (conf, batch-shape) pair
+        compiles once and every further batch is a cache hit.  BatchNorm
+        EMA advances inside the compiled step.  Hessian-free keeps the
+        legacy closure path (its Gauss-Newton product runs `predict` over
+        all rows, which the pad mask cannot reach)."""
         x, labels = jnp.asarray(x), jnp.asarray(labels)
         out_conf = self.conf.conf(self.conf.n_layers - 1)
-        objective = self._finetune_objective(x, labels)
-        self.params, scores = solver_mod.optimize(
-            objective, self.params, out_conf, self._next_key())
+        algo = OptimizationAlgorithm(str(out_conf.optimization_algo))
+        if (self.use_step_cache
+                and algo != OptimizationAlgorithm.HESSIAN_FREE):
+            self.params, scores = self.step_cache.finetune(
+                self.conf, self.params, x, labels, self._next_key())
+            self._bn_in_step = has_batchnorm(self.conf)
+        else:
+            objective = self._finetune_objective(x, labels)
+            self.params, scores = solver_mod.optimize(
+                objective, self.params, out_conf, self._next_key())
+            self._bn_in_step = False
         dispatch_listeners(self.listeners, self, scores)
 
     def fit(self, data, labels=None) -> None:
@@ -393,13 +450,16 @@ class MultiLayerNetwork:
             batches = _as_batches(data)
         for batch in batches:
             x, y = batch if isinstance(batch, tuple) else (batch.features, batch.labels)
+            self._bn_in_step = False
             if self.conf.pretrain:
                 self.pretrain(jnp.asarray(x))
             if self.conf.backprop:
                 self.finetune(x, y)
-            if has_batchnorm(self.conf):
-                # true running EMA across every fit batch (not a post-hoc
-                # recompute from whatever batch happened to come last)
+            if has_batchnorm(self.conf) and not self._bn_in_step:
+                # legacy host path (cache disabled / backprop off): true
+                # running EMA across every fit batch via an extra partial
+                # forward.  The cached finetune already folded this into
+                # the compiled step from the solver's own forward.
                 if self._bn_ema_fn is None:
                     self._bn_ema_fn = jax.jit(partial(update_bn_ema, self.conf))
                 self.params = self._bn_ema_fn(self.params, jnp.asarray(x))

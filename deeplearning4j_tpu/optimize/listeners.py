@@ -14,6 +14,8 @@ from __future__ import annotations
 import logging
 from typing import Sequence
 
+import numpy as np
+
 log = logging.getLogger("deeplearning4j_tpu")
 
 
@@ -86,11 +88,34 @@ class ComposableIterationListener(IterationListener):
 
 
 def dispatch(listeners, model, scores) -> None:
-    """Replay per-iteration scores from a finished solver run."""
-    import numpy as np
+    """Replay per-iteration scores from a finished solver run.
 
-    scores = np.asarray(scores)
-    for i, s in enumerate(scores):
+    Cost discipline: with no listeners attached this returns before
+    touching the score array, so train steps stay fully async on the
+    device; with listeners the whole trace crosses device->host in ONE
+    `np.asarray` transfer, never one sync per iteration.
+
+    Early-terminated runs are handled explicitly: the solvers carry a
+    `done` flag and freeze the score once a termination condition trips,
+    so the trace ends in a run of exactly-equal values.  Only the first
+    element of such a trailing run (the real final iteration) is
+    replayed — listeners don't see masked post-termination iterations as
+    if they were live ones.  Non-finite scores are skipped (reference
+    `ScoreIterationListener` contract).
+    """
+    if not listeners:
+        return
+    scores = np.asarray(scores)  # the single device->host transfer
+    end = len(scores)
+    if end > 1:
+        last = scores[-1]
+        i = end - 1
+        while i > 0 and scores[i - 1] == last:  # nan-safe: nan != nan
+            i -= 1
+        if end - i >= 2:  # a run of >= 2 equal scores = frozen tail
+            end = i + 1
+    for i in range(end):
+        s = scores[i]
         if not np.isfinite(s):
             continue
         for l in listeners:

@@ -36,6 +36,12 @@ from deeplearning4j_tpu.optimize.updater import adjust_gradient, init_updater
 
 EPS_TERMINATION = 1e-6   # |score - old_score| tolerance (EpsTermination parity)
 NORM2_TERMINATION = 1e-8  # gradient-norm tolerance (Norm2Termination parity)
+# consecutive sub-eps (or failed-line-search) iterations before terminating.
+# An f32 score's ulp near a large loss value dwarfs EPS_TERMINATION, so a
+# single exactly-equal score pair is a rounding coin-flip, not convergence —
+# solvers crossing a flat valley would otherwise freeze or survive it
+# depending on 1-ulp differences in how their loss happened to be lowered.
+STALL_PATIENCE = 2
 
 
 class Objective(NamedTuple):
@@ -46,11 +52,55 @@ class Objective(NamedTuple):
     gnvp (optional): (params, v_pytree, key) -> pytree — Gauss-Newton
         curvature-vector product for Hessian-free; when absent HF uses the
         exact Hessian-vector product (jvp of the gradient).
+    grad_score_aux (optional): (params, key) -> (grads, score, aux_pytree)
+        — a side channel for byproducts of the gradient forward (e.g.
+        BatchNorm batch moments) that the caller wants back without paying
+        a second forward pass.  Solvers carry the aux of the LAST live
+        iteration through their scan (frozen once terminated) and
+        `optimize_with_aux` returns it alongside the result.
     """
 
     grad_and_score: Callable
     score: Callable
     gnvp: Optional[Callable] = None
+    grad_score_aux: Optional[Callable] = None
+
+
+class BatchedObjective(NamedTuple):
+    """An Objective whose callables take the batch explicitly —
+    `(params, x, y, key)` instead of closing over the batch arrays.
+
+    This is the contract the compiled train-step cache
+    (`optimize/step_cache.py`) needs: with (x, y) as jit ARGUMENTS the
+    solver program compiles once per (conf, shapes) and is reused for
+    every batch, instead of baking each batch in as constants and
+    re-tracing the whole `lax.scan` per `fit` call.
+    """
+
+    grad_and_score: Callable                 # (params, x, y, key) -> (g, s)
+    score: Callable                          # (params, x, y, key) -> s
+    gnvp: Optional[Callable] = None          # (params, v, x, y, key) -> pytree
+    grad_score_aux: Optional[Callable] = None  # (params, x, y, key) -> (g, s, aux)
+
+    def bind(self, x, y) -> "Objective":
+        """Close over one batch (concrete arrays or jit tracers)."""
+        return Objective(
+            grad_and_score=lambda p, k: self.grad_and_score(p, x, y, k),
+            score=lambda p, k: self.score(p, x, y, k),
+            gnvp=(None if self.gnvp is None
+                  else lambda p, v, k: self.gnvp(p, v, x, y, k)),
+            grad_score_aux=(None if self.grad_score_aux is None
+                            else lambda p, k: self.grad_score_aux(p, x, y, k)))
+
+
+def batched_from_loss(loss_fn: Callable) -> BatchedObjective:
+    """BatchedObjective from a pure loss `(params, x, y, key) -> scalar`."""
+
+    def gs(params, x, y, key):
+        s, g = jax.value_and_grad(loss_fn)(params, x, y, key)
+        return g, s
+
+    return BatchedObjective(grad_and_score=gs, score=loss_fn)
 
 
 def from_loss(loss_fn: Callable) -> Objective:
@@ -95,14 +145,18 @@ def make_termination(conf):
     n2 = getattr(conf, "termination_norm2", NORM2_TERMINATION)
 
     def terminated(score, old_score, gnorm, dnorm=None):
-        done = jnp.asarray(False)
+        """(stall, hard): `stall` is the eps plateau condition — callers
+        terminate only after STALL_PATIENCE consecutive stalls; `hard`
+        conditions (norm2 / zero_direction) terminate immediately."""
+        stall = jnp.asarray(False)
+        hard = jnp.asarray(False)
         if "eps" in conds:
-            done = jnp.logical_or(done, jnp.abs(score - old_score) < eps)
+            stall = jnp.logical_or(stall, jnp.abs(score - old_score) < eps)
         if "norm2" in conds:
-            done = jnp.logical_or(done, gnorm < n2)
+            hard = jnp.logical_or(hard, gnorm < n2)
         if "zero_direction" in conds and dnorm is not None:
-            done = jnp.logical_or(done, dnorm < 1e-12)
-        return done
+            hard = jnp.logical_or(hard, dnorm < 1e-12)
+        return stall, hard
 
     return terminated
 
@@ -129,15 +183,34 @@ def _terminated(score, old_score, gnorm):
     )
 
 
+def _aux_zeros(objective: Objective, params0, key):
+    """Initial aux carry: a zero pytree shaped like the objective's aux
+    output (abstract eval only — no FLOPs spent)."""
+    if objective.grad_score_aux is None:
+        return ()
+    shapes = jax.eval_shape(objective.grad_score_aux, params0, key)[2]
+    return jax.tree_util.tree_map(
+        lambda s: jnp.zeros(s.shape, s.dtype), shapes)
+
+
+def _grad_score_aux(objective: Objective, params, key):
+    """(grads, score, aux) whichever channel the objective provides."""
+    if objective.grad_score_aux is not None:
+        return objective.grad_score_aux(params, key)
+    g, s = objective.grad_and_score(params, key)
+    return g, s, ()
+
+
 def _sgd(objective: Objective, params0, conf, key):
     """ITERATION_GRADIENT_DESCENT: updater-chain steps, no line search."""
     upd0 = init_updater(params0)
     terminated = make_termination(conf)
+    aux0 = _aux_zeros(objective, params0, key)
 
     def step(carry, it):
-        params, upd, k, done, old_score = carry
+        params, upd, k, done, old_score, stall_n, aux = carry
         k, sub = jax.random.split(k)
-        grads, score = objective.grad_and_score(params, sub)
+        grads, score, aux_new = _grad_score_aux(objective, params, sub)
         adj, upd_new = adjust_gradient(conf, it, grads, params, upd)
         gnorm = jnp.sqrt(sum(jnp.sum(jnp.square(x.astype(jnp.float32)))
                              for x in jax.tree_util.tree_leaves(grads)))
@@ -153,14 +226,20 @@ def _sgd(objective: Objective, params0, conf, key):
             lambda old, new: jnp.where(done, old, new), params, new_params)
         upd = jax.tree_util.tree_map(
             lambda old, new: jnp.where(done, old, new), upd, upd_new)
-        done = jnp.logical_or(done, terminated(score, old_score, gnorm,
-                                               dnorm))
-        return (params, upd, k, done, score), score
+        aux = jax.tree_util.tree_map(
+            lambda old, new: jnp.where(done, old, new), aux, aux_new)
+        stall, hard = terminated(score, old_score, gnorm, dnorm)
+        stall_n = jnp.where(done, stall_n,
+                            jnp.where(stall, stall_n + 1, 0))
+        done = jnp.logical_or(done, jnp.logical_or(
+            hard, stall_n >= STALL_PATIENCE))
+        return (params, upd, k, done, score, stall_n, aux), score
 
-    init = (params0, upd0, key, jnp.asarray(False), jnp.inf)
-    (params, _, _, _, _), scores = jax.lax.scan(
+    init = (params0, upd0, key, jnp.asarray(False), jnp.inf,
+            jnp.asarray(0), aux0)
+    (params, _, _, _, _, _, aux), scores = jax.lax.scan(
         step, init, jnp.arange(conf.num_iterations))
-    return params, scores
+    return params, scores, aux
 
 
 def _line_searched(objective: Objective, params0, conf, key, algo):
@@ -173,18 +252,21 @@ def _line_searched(objective: Objective, params0, conf, key, algo):
         return objective.score(unravel(x), k)
 
     def grad_flat(x, k):
-        g, s = objective.grad_and_score(unravel(x), k)
-        return ravel_pytree(g)[0], s
+        g, s, aux = _grad_score_aux(objective, unravel(x), k)
+        return ravel_pytree(g)[0], s, aux
 
     is_cg = algo == OptimizationAlgorithm.CONJUGATE_GRADIENT
     is_lbfgs = algo == OptimizationAlgorithm.LBFGS
     terminated = make_termination(conf)
+    aux0 = _aux_zeros(objective, params0, key)
 
     def step(carry, it):
         (x, x_prev, g_prev, d_prev, s_hist, y_hist, hist_n, k, done,
-         old_score, prev_alpha) = carry
+         old_score, prev_alpha, stall_n, aux) = carry
         k, kg = jax.random.split(k)
-        g, score = grad_flat(x, kg)
+        g, score, aux_new = grad_flat(x, kg)
+        aux = jax.tree_util.tree_map(
+            lambda old, new: jnp.where(done, old, new), aux, aux_new)
         gnorm = jnp.linalg.norm(g)
 
         if is_lbfgs:
@@ -250,11 +332,16 @@ def _line_searched(objective: Objective, params0, conf, key, algo):
         x_new = apply_step(conf, x, d, alpha)
 
         progressed = alpha > 0
-        done_new = jnp.logical_or(
-            done,
-            jnp.logical_or(~progressed,
-                           terminated(new_score, old_score, gnorm,
-                                      jnp.linalg.norm(d))))
+        stall, hard = terminated(new_score, old_score, gnorm,
+                                 jnp.linalg.norm(d))
+        # a failed line search is a soft stall too: the next iteration
+        # retries with a fresh direction (CG restarts to -g) before the
+        # run is declared converged
+        stall = jnp.logical_or(stall, ~progressed)
+        stall_n = jnp.where(done, stall_n,
+                            jnp.where(stall, stall_n + 1, 0))
+        done_new = jnp.logical_or(done, jnp.logical_or(
+            hard, stall_n >= STALL_PATIENCE))
 
         x_prev_out = jnp.where(done, x_prev, x)
         x_out = jnp.where(done, x, x_new)
@@ -264,14 +351,14 @@ def _line_searched(objective: Objective, params0, conf, key, algo):
         prev_alpha = jnp.where(jnp.logical_or(done, alpha == 0.0),
                                prev_alpha, alpha)
         return (x_out, x_prev_out, g_prev, d_prev, s_hist, y_hist, hist_n, k,
-                done_new, out_score, prev_alpha), out_score
+                done_new, out_score, prev_alpha, stall_n, aux), out_score
 
     init = (x0, x0, jnp.zeros_like(x0), jnp.zeros_like(x0),
             jnp.zeros((m, n), x0.dtype), jnp.zeros((m, n), x0.dtype),
             jnp.asarray(0), key, jnp.asarray(False), jnp.inf,
-            jnp.asarray(0.5, x0.dtype))
-    (xf, *_), scores = jax.lax.scan(step, init, jnp.arange(conf.num_iterations))
-    return unravel(xf), scores
+            jnp.asarray(0.5, x0.dtype), jnp.asarray(0), aux0)
+    carry, scores = jax.lax.scan(step, init, jnp.arange(conf.num_iterations))
+    return unravel(carry[0]), scores, carry[-1]
 
 
 def _hessian_free(objective: Objective, params0, conf, key):
@@ -284,10 +371,15 @@ def _hessian_free(objective: Objective, params0, conf, key):
     """
     x0, unravel = ravel_pytree(params0)
     terminated = make_termination(conf)
+    aux0 = _aux_zeros(objective, params0, key)
 
     def grad_flat(x, k):
-        g, s = objective.grad_and_score(unravel(x), k)
+        g, s, _ = _grad_score_aux(objective, unravel(x), k)
         return ravel_pytree(g)[0], s
+
+    def grad_flat_aux(x, k):
+        g, s, aux = _grad_score_aux(objective, unravel(x), k)
+        return ravel_pytree(g)[0], s, aux
 
     def score_flat(x, k):
         return objective.score(unravel(x), k)
@@ -330,9 +422,11 @@ def _hessian_free(objective: Objective, params0, conf, key):
         return d
 
     def step(carry, it):
-        x, d_prev, lam, k, done, old_score = carry
+        x, d_prev, lam, k, done, old_score, stall_n, aux = carry
         k, kg = jax.random.split(k)
-        g, score = grad_flat(x, kg)
+        g, score, aux_new = grad_flat_aux(x, kg)
+        aux = jax.tree_util.tree_map(
+            lambda old, new: jnp.where(done, old, new), aux, aux_new)
         gnorm = jnp.linalg.norm(g)
         d = cg_solve(x, g, lam, 0.95 * d_prev, kg)
         # quadratic-model reduction for the LM rho test
@@ -349,15 +443,29 @@ def _hessian_free(objective: Objective, params0, conf, key):
         # old_score, which starts at +inf and would leak into the trace)
         out_score = jnp.where(done, old_score,
                               jnp.where(accept, new_score, score))
-        done = jnp.logical_or(done, terminated(new_score, old_score, gnorm,
-                                               jnp.linalg.norm(d)))
-        return (x_new, d_prev, lam, k, done, out_score), out_score
+        stall, hard = terminated(new_score, old_score, gnorm,
+                                 jnp.linalg.norm(d))
+        stall_n = jnp.where(done, stall_n,
+                            jnp.where(stall, stall_n + 1, 0))
+        done = jnp.logical_or(done, jnp.logical_or(
+            hard, stall_n >= STALL_PATIENCE))
+        return (x_new, d_prev, lam, k, done, out_score, stall_n,
+                aux), out_score
 
     init = (x0, jnp.zeros_like(x0), jnp.asarray(conf.hf_initial_lambda),
-            key, jnp.asarray(False), jnp.inf)
-    (xf, *_), scores = jax.lax.scan(step, init,
-                                    jnp.arange(conf.num_iterations))
-    return unravel(xf), scores
+            key, jnp.asarray(False), jnp.inf, jnp.asarray(0), aux0)
+    carry, scores = jax.lax.scan(step, init,
+                                 jnp.arange(conf.num_iterations))
+    return unravel(carry[0]), scores, carry[-1]
+
+
+def _optimize_impl(objective: Objective, params0, conf, key):
+    algo = OptimizationAlgorithm(str(conf.optimization_algo))
+    if algo == OptimizationAlgorithm.ITERATION_GRADIENT_DESCENT:
+        return _sgd(objective, params0, conf, key)
+    if algo == OptimizationAlgorithm.HESSIAN_FREE:
+        return _hessian_free(objective, params0, conf, key)
+    return _line_searched(objective, params0, conf, key, algo)
 
 
 def optimize(objective: Objective, params0, conf, key):
@@ -365,12 +473,16 @@ def optimize(objective: Objective, params0, conf, key):
 
     Dispatch parity: `Solver.java:54-70`.
     """
-    algo = OptimizationAlgorithm(str(conf.optimization_algo))
-    if algo == OptimizationAlgorithm.ITERATION_GRADIENT_DESCENT:
-        return _sgd(objective, params0, conf, key)
-    if algo == OptimizationAlgorithm.HESSIAN_FREE:
-        return _hessian_free(objective, params0, conf, key)
-    return _line_searched(objective, params0, conf, key, algo)
+    params, scores, _ = _optimize_impl(objective, params0, conf, key)
+    return params, scores
+
+
+def optimize_with_aux(objective: Objective, params0, conf, key):
+    """Like `optimize`, but also returns the aux pytree from the last live
+    iteration's `grad_score_aux` call (an empty tuple when the objective
+    has no aux channel).  This is how compiled train steps get BatchNorm
+    batch moments out of the solver without a second forward pass."""
+    return _optimize_impl(objective, params0, conf, key)
 
 
 class Solver:

@@ -1,0 +1,283 @@
+"""Compiled train-step cache — compile once, execute many.
+
+The core lesson of both the TPU paper (Jouppi et al.) and TensorFlow's
+dataflow design (Abadi et al.) is that each (config, batch-shape) pair
+should lower to ONE XLA program reused for the whole run.  Before this
+module the single-chip path violated that: `MultiLayerNetwork.finetune`
+closed a fresh loss over each batch's arrays and handed it to
+`solver_mod.optimize`, so the entire solver `lax.scan` was re-traced and
+re-compiled per batch with the batch data baked in as constants.
+
+Design:
+
+  key schema    (kind, conf-fingerprint, algorithm, arg shapes/dtypes,
+                 pretrain-layer index) -> AOT-compiled XLA executable.
+                 The fingerprint is a sha1 of the frozen config's
+                 canonical JSON, so config edits can never alias a stale
+                 program.
+  batch args    batch data (x, labels, row weights) are explicit jit
+                 ARGUMENTS of the compiled program (see
+                 `solver.BatchedObjective`), never closure constants.
+  donation      params are donated to the step (`donate_argnums=(0,)`) on
+                 accelerator backends, so the single-chip path stops
+                 double-buffering parameters in HBM.  Donation is skipped
+                 on CPU, where XLA would only warn.  Caveat: a donated
+                 params buffer is dead after the call — `clone()`d
+                 networks sharing params with a training net must copy
+                 first on TPU (`parallel.data_parallel.init_train_state`
+                 already does).
+  bucketing     remainder batches are zero-padded up to the smallest
+                 already-known bucket that fits (buckets grow on demand
+                 from the full-batch sizes actually seen), and pad rows
+                 carry row-weight 0 through the existing
+                 `network_rowwise_loss(..., row_weights=...)` machinery —
+                 masked out of the loss, the gradients AND the BatchNorm
+                 batch statistics.  A full epoch therefore compiles at
+                 most n_buckets programs instead of one per tail shape.
+  observability `cache.stats` tracks hits, misses, steps executed and
+                 per-key compile seconds; every miss is logged so
+                 retraces are observable instead of silent.
+
+Hessian-free finetune stays on the uncached legacy path: its Gauss-Newton
+product evaluates `predict` over ALL rows, which a zero-pad mask cannot
+reach (ROADMAP open item).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import logging
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.optimize import solver as solver_mod
+
+log = logging.getLogger("deeplearning4j_tpu")
+
+
+def conf_fingerprint(conf) -> str:
+    """Stable fingerprint of a frozen config: sha1 of its canonical JSON
+    (sorted keys), truncated — collision-safe far beyond any realistic
+    number of configs per process."""
+    return hashlib.sha1(conf.to_json().encode("utf-8")).hexdigest()[:16]
+
+
+def arg_signature(*arrays) -> Tuple:
+    """(shape, dtype) tuple per array — the shape part of the cache key."""
+    return tuple(
+        None if a is None else (tuple(a.shape), str(jnp.asarray(a).dtype))
+        for a in arrays)
+
+
+class StepCacheStats:
+    """Counters exposed on the cache object (ISSUE: observability)."""
+
+    def __init__(self):
+        self.hits = 0
+        self.misses = 0
+        self.steps = 0                      # compiled-step executions
+        self.compile_seconds: Dict[Tuple, float] = {}  # key -> seconds
+
+    @property
+    def total_compile_seconds(self) -> float:
+        return float(sum(self.compile_seconds.values()))
+
+    def as_dict(self) -> dict:
+        return {"hits": self.hits, "misses": self.misses,
+                "steps": self.steps, "entries": len(self.compile_seconds),
+                "compile_seconds": round(self.total_compile_seconds, 3)}
+
+    def __repr__(self):
+        return f"StepCacheStats({self.as_dict()})"
+
+
+class TrainStepCache:
+    """Memoizes AOT-compiled solver programs.
+
+    donate: None = donate params on accelerator backends only (CPU XLA
+    ignores donation with a warning); True/False force it.
+    buckets: optional fixed iterable of allowed batch-row buckets; by
+    default buckets grow on demand from the batch sizes seen (full
+    batches come first in practice, tails then pad up into them).
+    """
+
+    def __init__(self, donate: Optional[bool] = None,
+                 buckets: Optional[Tuple[int, ...]] = None):
+        self._programs: Dict[Tuple, Callable] = {}
+        self._fingerprints: Dict[int, str] = {}  # id(conf) memo
+        self._buckets: List[int] = sorted(buckets) if buckets else []
+        self._fixed_buckets = buckets is not None
+        self._donate = donate
+        self.stats = StepCacheStats()
+
+    # -- bucket policy ------------------------------------------------------
+    def bucket_rows(self, n: int) -> int:
+        """Smallest known bucket >= n; otherwise n becomes a new bucket
+        (fixed bucket sets never grow — an oversize batch runs unpadded
+        as its own bucket, logged)."""
+        for b in self._buckets:
+            if b >= n:
+                return b
+        if self._fixed_buckets and self._buckets:
+            log.info("step-cache: batch of %d rows exceeds the fixed "
+                     "buckets %s; running unpadded", n, self._buckets)
+        else:
+            self._buckets.append(n)
+            self._buckets.sort()
+        return n
+
+    @property
+    def buckets(self) -> Tuple[int, ...]:
+        return tuple(self._buckets)
+
+    # -- program lookup -----------------------------------------------------
+    def _fingerprint(self, conf) -> str:
+        fp = self._fingerprints.get(id(conf))
+        if fp is None:
+            fp = conf_fingerprint(conf)
+            self._fingerprints[id(conf)] = fp
+        return fp
+
+    def _donate_argnums(self) -> Tuple[int, ...]:
+        donate = self._donate
+        if donate is None:
+            donate = jax.default_backend() != "cpu"
+        return (0,) if donate else ()
+
+    def _get(self, key: Tuple, build: Callable[[], Callable], args: Tuple):
+        """Return the compiled executable for `key`, compiling (and
+        timing) it via AOT lower+compile on a miss."""
+        fn = self._programs.get(key)
+        if fn is not None:
+            self.stats.hits += 1
+            return fn
+        self.stats.misses += 1
+        t0 = time.perf_counter()
+        jitted = jax.jit(build(), donate_argnums=self._donate_argnums())
+        abstract = jax.tree_util.tree_map(
+            lambda a: jax.ShapeDtypeStruct(jnp.shape(a),
+                                           jnp.asarray(a).dtype), args)
+        fn = jitted.lower(*abstract).compile()
+        dt = time.perf_counter() - t0
+        self.stats.compile_seconds[key] = dt
+        log.info("step-cache miss: compiled %s in %.2fs (entry %d)",
+                 key, dt, len(self._programs) + 1)
+        self._programs[key] = fn
+        return fn
+
+    def clear(self) -> None:
+        self._programs.clear()
+        self._buckets = sorted(self._buckets) if self._fixed_buckets else []
+        self.stats = StepCacheStats()
+
+    def __len__(self):
+        return len(self._programs)
+
+    # -- padding ------------------------------------------------------------
+    @staticmethod
+    def pad_batch(x, y, bucket: int):
+        """Zero-pad (x, y) up to `bucket` feature rows and build the
+        per-label-row weight vector (pad rows weigh 0).  Label rows may
+        be a multiple of feature rows (B*T for sequence models)."""
+        b = x.shape[0]
+        ratio = max(1, y.shape[0] // max(1, b))
+        pad = bucket - b
+        w = jnp.concatenate([jnp.ones(b * ratio, jnp.float32),
+                             jnp.zeros(pad * ratio, jnp.float32)])
+        if pad:
+            x = jnp.concatenate(
+                [x, jnp.zeros((pad,) + x.shape[1:], x.dtype)])
+            y = jnp.concatenate(
+                [y, jnp.zeros((pad * ratio,) + y.shape[1:], y.dtype)])
+        return x, y, w
+
+    # -- network train steps ------------------------------------------------
+    def finetune(self, conf, params, x, y, key):
+        """One cached supervised solver run (`MultiLayerNetwork.finetune`
+        body): pads (x, y) to the bucket, fetches/compiles the program
+        for (conf, algo, shapes) and executes it.
+
+        Returns (new_params, per-iteration scores).  BatchNorm running
+        stats are advanced INSIDE the program from the last solver
+        iteration's batch moments (`update_bn_ema_from_stats`) — no
+        second forward pass."""
+        from deeplearning4j_tpu.nn.multilayer import has_batchnorm
+
+        out_conf = conf.conf(conf.n_layers - 1)
+        bucket = self.bucket_rows(int(x.shape[0]))
+        x, y, w = self.pad_batch(x, y, bucket)
+        collect_bn = has_batchnorm(conf)
+        cache_key = ("finetune", self._fingerprint(conf),
+                     str(out_conf.optimization_algo),
+                     arg_signature(x, y, w))
+        args = (params, x, y, w, key)
+        fn = self._get(cache_key,
+                       lambda: _finetune_program(conf, collect_bn), args)
+        self.stats.steps += 1
+        return fn(*args)
+
+    def pretrain(self, layer_conf, layer_idx: int, impl, layer_params, x,
+                 key):
+        """One cached layer-wise pretraining solver run
+        (`MultiLayerNetwork.pretrain_layer` body).  Pretraining
+        objectives take no row weights, so batches are NOT bucketed —
+        each distinct input shape compiles its own program (keyed by the
+        pretrain-layer index)."""
+        cache_key = ("pretrain", layer_idx, self._fingerprint(layer_conf),
+                     str(layer_conf.optimization_algo), arg_signature(x))
+        args = (layer_params, x, key)
+        fn = self._get(cache_key,
+                       lambda: _pretrain_program(layer_conf, impl), args)
+        self.stats.steps += 1
+        return fn(*args)
+
+
+def _finetune_program(conf, collect_bn: bool) -> Callable:
+    """Build the (uncompiled) finetune step: run the configured solver
+    over explicit batch args, then fold the BatchNorm EMA advance into
+    the same program."""
+    # local import: nn.multilayer imports this module at top level
+    from deeplearning4j_tpu.nn.multilayer import (make_finetune_loss,
+                                                  update_bn_ema_from_stats)
+
+    out_conf = conf.conf(conf.n_layers - 1)
+    loss_and_stats = make_finetune_loss(conf, collect_bn=collect_bn)
+
+    def program(params, x, y, w, key):
+        if collect_bn:
+            def gsa(p, k):
+                (s, stats), g = jax.value_and_grad(
+                    lambda pp, kk: loss_and_stats(pp, x, y, w, kk),
+                    has_aux=True)(p, k)
+                return g, s, stats
+
+            objective = solver_mod.Objective(
+                grad_and_score=lambda p, k: gsa(p, k)[:2],
+                score=lambda p, k: loss_and_stats(p, x, y, w, k)[0],
+                grad_score_aux=gsa)
+        else:
+            objective = solver_mod.from_loss(
+                lambda p, k: loss_and_stats(p, x, y, w, k)[0])
+        new_params, scores, aux = solver_mod.optimize_with_aux(
+            objective, params, out_conf, key)
+        if collect_bn:
+            new_params = update_bn_ema_from_stats(conf, new_params, aux)
+        return new_params, scores
+
+    return program
+
+
+def _pretrain_program(layer_conf, impl) -> Callable:
+    """Build the (uncompiled) layer-pretraining step over explicit x."""
+
+    def program(layer_params, x, key):
+        objective = solver_mod.Objective(
+            grad_and_score=lambda p, k: impl.pretrain_grad_and_score(
+                p, layer_conf, x, k),
+            score=lambda p, k: impl.pretrain_score(p, layer_conf, x, k))
+        return solver_mod.optimize(objective, layer_params, layer_conf, key)
+
+    return program

@@ -1,0 +1,261 @@
+"""Compiled train-step cache: compile-once semantics, bucketed padding
+exactness, and numerical identity with the uncached solver path."""
+
+import logging
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.models.zoo import mlp
+from deeplearning4j_tpu.nn.conf import (LayerType, MultiLayerConfiguration,
+                                        NeuralNetConfiguration,
+                                        OptimizationAlgorithm)
+from deeplearning4j_tpu.nn.multilayer import (MultiLayerNetwork,
+                                              make_finetune_loss)
+from deeplearning4j_tpu.optimize import solver as solver_mod
+from deeplearning4j_tpu.optimize.step_cache import (TrainStepCache,
+                                                    conf_fingerprint)
+
+KEY = jax.random.PRNGKey(7)
+
+
+def _data(n, n_in=6, n_out=3, seed=0):
+    rng = np.random.RandomState(seed)
+    x = rng.randn(n, n_in).astype(np.float32)
+    y = np.eye(n_out, dtype=np.float32)[rng.randint(0, n_out, n)]
+    return jnp.asarray(x), jnp.asarray(y)
+
+
+def _mlp_conf(algo=OptimizationAlgorithm.ITERATION_GRADIENT_DESCENT,
+              iters=3):
+    conf = mlp(n_in=6, hidden=[8, 8], n_out=3, lr=0.05)  # 3-layer MLP
+    return conf.replace(confs=tuple(
+        c.replace(optimization_algo=algo, num_iterations=iters)
+        for c in conf.confs))
+
+
+def _bn_conf(iters=3):
+    confs = (
+        NeuralNetConfiguration(layer_type=LayerType.BATCH_NORM, n_in=6,
+                               n_out=6),
+        NeuralNetConfiguration(
+            layer_type=LayerType.OUTPUT, n_in=6, n_out=3,
+            num_iterations=iters,
+            optimization_algo=OptimizationAlgorithm.ITERATION_GRADIENT_DESCENT),
+    )
+    return MultiLayerConfiguration(confs=confs)
+
+
+# -- compile-once semantics (acceptance criterion) --------------------------
+
+def test_four_equal_batches_compile_exactly_once():
+    """3-layer MLP over 4 equal-shape fit batches: ONE compile, 3 hits."""
+    net = MultiLayerNetwork(_mlp_conf(), seed=0).init()
+    x, y = _data(64)
+    for i in range(4):
+        net.fit(x[i * 16:(i + 1) * 16], y[i * 16:(i + 1) * 16])
+    st = net.step_cache.stats
+    assert st.misses == 1, st
+    assert st.hits == 3, st
+    assert st.steps == 4, st
+    assert len(net.step_cache) == 1
+
+
+def test_mixed_size_epoch_compiles_at_most_n_buckets():
+    """Epoch [16, 16, 10]: the tail pads into the 16-bucket — one program
+    total, and the cache never saw a second shape."""
+    net = MultiLayerNetwork(_mlp_conf(), seed=0).init()
+    x, y = _data(42)
+    for lo, hi in ((0, 16), (16, 32), (32, 42)):
+        net.fit(x[lo:hi], y[lo:hi])
+    st = net.step_cache.stats
+    assert st.misses == 1, st
+    assert st.hits == 2, st
+    assert net.step_cache.buckets == (16,)
+
+
+def test_different_shapes_compile_separately():
+    """A batch LARGER than every known bucket registers a new bucket and
+    compiles its own program."""
+    net = MultiLayerNetwork(_mlp_conf(), seed=0).init()
+    x, y = _data(48)
+    net.fit(x[:16], y[:16])
+    net.fit(x[:48], y[:48])      # 48 > 16: new bucket, new compile
+    net.fit(x[16:32], y[16:32])  # 16 again: hit
+    st = net.step_cache.stats
+    assert st.misses == 2, st
+    assert st.hits == 1, st
+    assert net.step_cache.buckets == (16, 48)
+
+
+def test_conf_change_compiles_separately():
+    """Different configs never alias a compiled program (fingerprint key)."""
+    cache = TrainStepCache()
+    c1 = _mlp_conf(iters=2)
+    c2 = _mlp_conf(iters=4)
+    assert conf_fingerprint(c1) != conf_fingerprint(c2)
+    x, y = _data(8)
+    p1 = MultiLayerNetwork(c1, seed=0).init().params
+    cache.finetune(c1, p1, x, y, KEY)
+    cache.finetune(c2, p1, x, y, KEY)
+    assert cache.stats.misses == 2
+
+
+# -- numerical identity with the uncached path ------------------------------
+
+@pytest.mark.parametrize("algo", [
+    OptimizationAlgorithm.ITERATION_GRADIENT_DESCENT,
+    OptimizationAlgorithm.CONJUGATE_GRADIENT,
+    OptimizationAlgorithm.LBFGS,
+])
+def test_cached_step_matches_uncached_optimize(algo):
+    """The cached program computes exactly what `solver_mod.optimize` on a
+    closure of the same loss computes — same params, same score trace."""
+    conf = _mlp_conf(algo=algo, iters=4)
+    out_conf = conf.conf(conf.n_layers - 1)
+    params0 = MultiLayerNetwork(conf, seed=3).init().params
+    x, y = _data(12)
+    w = jnp.ones(12, jnp.float32)
+
+    cached_p, cached_s = TrainStepCache().finetune(conf, params0, x, y, KEY)
+
+    loss = make_finetune_loss(conf)
+    objective = solver_mod.from_loss(lambda p, k: loss(p, x, y, w, k)[0])
+    ref_p, ref_s = solver_mod.optimize(objective, params0, out_conf, KEY)
+
+    np.testing.assert_array_equal(np.asarray(cached_s), np.asarray(ref_s))
+    for lc, lr in zip(cached_p, ref_p):
+        for name in lc:
+            np.testing.assert_array_equal(np.asarray(lc[name]),
+                                          np.asarray(lr[name]), err_msg=name)
+
+
+# -- bucketed remainder exactness (acceptance criterion) --------------------
+
+def test_padded_tail_matches_unpadded_tail_bitforbit():
+    """A 10-row tail padded into a 16-bucket trains to the SAME float32
+    params as the unpadded 10-row batch (row-weight masking exactness)."""
+    conf = _mlp_conf(iters=4)
+    params0 = MultiLayerNetwork(conf, seed=5).init().params
+    x, y = _data(10, seed=2)
+
+    padded_cache = TrainStepCache()
+    assert padded_cache.bucket_rows(16) == 16  # pre-register the bucket
+    p_pad, s_pad = padded_cache.finetune(conf, params0, x, y, KEY)
+    assert padded_cache.buckets == (16,)
+
+    plain_cache = TrainStepCache()  # no bucket >= 10 known: runs unpadded
+    p_ref, s_ref = plain_cache.finetune(conf, params0, x, y, KEY)
+    assert plain_cache.buckets == (10,)
+
+    np.testing.assert_array_equal(np.asarray(s_pad), np.asarray(s_ref))
+    for lc, lr in zip(p_pad, p_ref):
+        for name in lc:
+            a, b = np.asarray(lc[name]), np.asarray(lr[name])
+            assert a.dtype == np.float32
+            np.testing.assert_array_equal(a, b, err_msg=name)
+
+
+def test_padded_tail_batchnorm_stats_match_unpadded():
+    """BatchNorm path: pad rows must not leak into the batch statistics —
+    padded and unpadded tails produce identical EMA entries."""
+    conf = _bn_conf(iters=3)
+    params0 = MultiLayerNetwork(conf, seed=1).init().params
+    x, y = _data(10, seed=4)
+
+    padded = TrainStepCache()
+    padded.bucket_rows(16)
+    p_pad, _ = padded.finetune(conf, params0, x, y, KEY)
+    p_ref, _ = TrainStepCache().finetune(conf, params0, x, y, KEY)
+
+    for name in ("ema_mean", "ema_var", "ema_w"):
+        np.testing.assert_array_equal(np.asarray(p_pad[0][name]),
+                                      np.asarray(p_ref[0][name]),
+                                      err_msg=name)
+    # and the stats are REAL: ema mean tracks the batch mean
+    mean = np.asarray(p_pad[0]["ema_mean"]) / float(p_pad[0]["ema_w"])
+    np.testing.assert_allclose(mean, np.asarray(x).mean(0), atol=0.2)
+
+
+def test_bn_fit_skips_second_forward_ema_pass():
+    """fit() on a BN net through the cache advances the EMA inside the
+    compiled step (no legacy `update_bn_ema` recompute) and still lands
+    near the batch mean."""
+    net = MultiLayerNetwork(_bn_conf(iters=5), seed=0).init()
+    rng = np.random.RandomState(0)
+    x = (rng.rand(32, 6).astype(np.float32) * 5 + 3)
+    y = np.eye(3, dtype=np.float32)[rng.randint(0, 3, 32)]
+    net.fit(x, y)
+    assert net._bn_in_step  # the compiled step owned the EMA update
+    assert net._bn_ema_fn is None  # legacy path never compiled
+    p = net.params[0]
+    mean = np.asarray(p["ema_mean"]) / max(float(p["ema_w"]), 1e-8)
+    assert np.all(np.abs(mean - x.mean(0)) < 0.5)
+
+
+# -- pretraining path -------------------------------------------------------
+
+def test_pretrain_layers_cache_by_layer_index():
+    """DBN pretraining: each layer's solver program compiles once and is
+    keyed by layer index; a second pretrain pass over the same shapes is
+    all hits."""
+    from deeplearning4j_tpu.models.zoo import dbn
+
+    conf = dbn(n_in=6, hidden=[8, 4], n_out=3, iterations=2,
+               finetune_iterations=2)
+    net = MultiLayerNetwork(conf, seed=0).init()
+    x, y = _data(16)
+    net.fit(x, y)
+    first = net.step_cache.stats.misses
+    assert first >= 3  # two RBM layers + the finetune program
+    net.fit(x, y)
+    assert net.step_cache.stats.misses == first  # second epoch: all hits
+
+
+# -- observability ----------------------------------------------------------
+
+def test_compile_seconds_recorded_and_misses_logged(caplog):
+    net = MultiLayerNetwork(_mlp_conf(), seed=0).init()
+    x, y = _data(8)
+    with caplog.at_level(logging.INFO, logger="deeplearning4j_tpu"):
+        net.fit(x, y)
+        net.fit(x, y)
+    st = net.step_cache.stats
+    assert st.total_compile_seconds > 0
+    assert len(st.compile_seconds) == 1
+    misses_logged = [r for r in caplog.records
+                     if "step-cache miss" in r.getMessage()]
+    assert len(misses_logged) == 1  # the hit did NOT log
+    d = st.as_dict()
+    assert d["hits"] == 1 and d["misses"] == 1 and d["steps"] == 2
+
+
+def test_use_step_cache_false_restores_legacy_path():
+    net = MultiLayerNetwork(_mlp_conf(), seed=0).init()
+    net.use_step_cache = False
+    x, y = _data(8)
+    net.fit(x, y)
+    assert net.step_cache.stats.steps == 0
+    assert np.isfinite(net.score(x, y))
+
+
+def test_listener_dispatch_truncates_frozen_tail():
+    """dispatch replays the real final iteration of an early-terminated
+    trace once, not every masked post-termination copy."""
+    from deeplearning4j_tpu.optimize.listeners import (IterationListener,
+                                                       dispatch)
+
+    seen = []
+
+    class Rec(IterationListener):
+        def iteration_done(self, model, iteration, score):
+            seen.append((iteration, score))
+
+    dispatch([Rec()], None, np.array([5.0, 4.0, 3.0, 2.0, 2.0, 2.0, 2.0]))
+    assert seen == [(0, 5.0), (1, 4.0), (2, 3.0), (3, 2.0)]
+
+    seen.clear()  # no trailing run: nothing truncated
+    dispatch([Rec()], None, np.array([3.0, 2.0, 1.0]))
+    assert seen == [(0, 3.0), (1, 2.0), (2, 1.0)]
