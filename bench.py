@@ -536,6 +536,99 @@ def bench_step_cache(devs) -> None:
 
 
 # ---------------------------------------------------------------------------
+# infer cache — steady-state serve-path output() latency, compile excluded
+# ---------------------------------------------------------------------------
+
+def bench_infer_latency(devs) -> None:
+    """Single-chip `MultiLayerNetwork.output` through the serve-path AOT
+    cache (optimize/infer_cache.py): the warm-up call pays the one compile,
+    then every timed call is a cache hit on the same executable.  Reports
+    p50 per-call latency and steady-state throughput, plus the cache's
+    compile-seconds total as its own line (mirrors bench_step_cache)."""
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.models.zoo import mlp
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+    batch, warmup, calls = (32, 2, 8) if SMALL else (1024, 4, 60)
+    conf = mlp(784, [512, 512], 10)
+    net = MultiLayerNetwork(conf, seed=0).init()
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.rand(batch, 784), jnp.float32)
+
+    tw = time.perf_counter()
+    for _ in range(warmup):  # first call compiles; the rest prove the hits
+        _host_sync(net.output(x))
+    warm_s = time.perf_counter() - tw
+
+    lat = []
+    for _ in range(calls):
+        t0 = time.perf_counter()
+        _host_sync(net.output(x))
+        lat.append(time.perf_counter() - t0)
+    p50_ms = float(np.percentile(lat, 50)) * 1e3
+
+    st = net.infer_cache.stats
+    _emit("infer-cache steady-state output p50 latency", p50_ms, "ms/call",
+          None, batch=batch,
+          samples_per_sec=round(calls * batch / sum(lat), 1),
+          cache_hits=st.hits, cache_misses=st.misses,
+          warmup_seconds=round(warm_s, 1))
+    _emit("infer-cache compile seconds total", st.total_compile_seconds,
+          "seconds", None, entries=len(st.compile_seconds),
+          baseline_note="one-time cost; p50 line above excludes it")
+
+
+# ---------------------------------------------------------------------------
+# prefetch — LeNet mini-batch fit with the async device_put pipeline on/off
+# ---------------------------------------------------------------------------
+
+def bench_prefetch(devs) -> None:
+    """LeNet train epoch over host-resident mini-batches, with and without
+    the async host->device prefetch pipeline (datasets/iterator.py
+    PrefetchIterator).  Both passes run after a compile warm-up epoch, so
+    the delta isolates the input feed: transfer overlapped with compute
+    vs transfer serialized before each step."""
+    import jax.numpy as jnp  # noqa: F401 — backend init before timing
+
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+    from deeplearning4j_tpu.datasets.iterator import PrefetchIterator
+    from deeplearning4j_tpu.models.zoo import lenet5
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+    batch, n_batches = (32, 3) if SMALL else (1024, 12)
+    conf = _mixed(lenet5())
+    net = MultiLayerNetwork(conf, seed=0).init()
+    rng = np.random.RandomState(0)
+    eye = np.eye(10, dtype=np.float32)
+    batches = [DataSet(rng.rand(batch, 784).astype(np.float32),
+                       eye[rng.randint(0, 10, batch)])
+               for _ in range(n_batches)]
+
+    tw = time.perf_counter()
+    net.fit(batches)  # warm-up epoch: pays the one solver compile
+    _host_sync(net.params)
+    warm_s = time.perf_counter() - tw
+
+    t0 = time.perf_counter()
+    net.fit(batches)  # host-synchronous feed: device_put blocks each step
+    _host_sync(net.params)
+    plain_s = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    net.fit(PrefetchIterator(batches))  # transfer one batch ahead
+    _host_sync(net.params)
+    prefetch_s = time.perf_counter() - t0
+
+    n = n_batches * batch
+    _emit("prefetch LeNet train samples/sec", n / prefetch_s, "samples/sec",
+          None, samples_per_sec_no_prefetch=round(n / plain_s, 1),
+          speedup_vs_no_prefetch=round(plain_s / prefetch_s, 3),
+          warmup_seconds=round(warm_s, 1),
+          baseline_note="vs same loop without the async device_put pipeline")
+
+
+# ---------------------------------------------------------------------------
 # north_star — LeNet-MNIST and the 4-layer char-LSTM end-to-end FROM THE CLI
 # ---------------------------------------------------------------------------
 
@@ -608,8 +701,8 @@ def bench_north_star_cli(devs) -> None:
 # (timeout-shortened) run still captures the five baseline metrics.
 BENCHES = [bench_lenet, bench_char_lstm, bench_vgg_cifar10, bench_word2vec,
            bench_dp_allreduce,
-           bench_char_lstm4, bench_step_cache, bench_north_star_cli,
-           bench_transformer_mfu]
+           bench_char_lstm4, bench_step_cache, bench_infer_latency,
+           bench_prefetch, bench_north_star_cli, bench_transformer_mfu]
 BASELINE_FIVE = {"bench_lenet", "bench_char_lstm", "bench_vgg_cifar10",
                  "bench_word2vec", "bench_dp_allreduce"}
 

@@ -197,8 +197,14 @@ def cmd_train(args) -> int:
                     # mini-batch loop: each (conf, bucket-shape) pair
                     # compiles ONE solver program in net.step_cache and
                     # every further batch is a cache hit; the remainder
-                    # batch pads into the full-batch bucket
-                    for b in data.batch_by(batch):
+                    # batch pads into the full-batch bucket.  Prefetch
+                    # device_puts each batch one step ahead on a
+                    # background thread so the compiled step never waits
+                    # on host->device transfer.
+                    from deeplearning4j_tpu.datasets.iterator import (
+                        PrefetchIterator)
+
+                    for b in PrefetchIterator(data.batch_by(batch)):
                         net.fit(b.features,
                                 b.features if reconstruction else b.labels)
                 else:
@@ -213,19 +219,22 @@ def cmd_train(args) -> int:
     checkpoint.save(args.output, net.params, conf=conf,
                     metadata={"score": score, "input": args.input})
     cs = net.step_cache.stats  # mesh runtime bypasses it: zeros
+    ic = net.infer_cache.stats  # the final score() above serves from it
     print(json.dumps({"saved": args.output, "score": score,
                       "train_seconds": round(train_seconds, 3),
                       "examples_per_sec": round(
                           n_trained / max(train_seconds, 1e-9), 2),
                       "compile_seconds": round(cs.total_compile_seconds, 3),
                       "cache_hits": cs.hits,
-                      "cache_misses": cs.misses}))
+                      "cache_misses": cs.misses,
+                      "infer_compile_seconds": round(
+                          ic.total_compile_seconds, 3)}))
     return 0
 
 
 def cmd_test(args) -> int:
     from deeplearning4j_tpu.cli.schemes import load_input
-    from deeplearning4j_tpu.evaluation import Evaluation
+    from deeplearning4j_tpu.evaluation import evaluate
 
     net = _load_model(args.model)
     data = load_input(args.input, label_column=args.label_column,
@@ -234,10 +243,17 @@ def cmd_test(args) -> int:
         data = data.normalize_zero_mean_unit_variance()
     if getattr(args, "scale_01", False):
         data = data.scale_to_unit()
-    ev = Evaluation()
-    ev.eval(data.labels, net.output(data.features))
+    # bucketed eval: fixed-size batches through the serve-path compile
+    # cache with one-batch-ahead host->device prefetch, instead of one
+    # giant device call over the whole dataset
+    ev = evaluate(net, data, batch_size=args.batch)
     print(ev.stats())
-    print(json.dumps({"accuracy": ev.accuracy(), "f1": ev.f1()}))
+    ic = net.infer_cache.stats
+    print(json.dumps({"accuracy": ev.accuracy(), "f1": ev.f1(),
+                      "infer_compile_seconds": round(
+                          ic.total_compile_seconds, 3),
+                      "infer_cache_hits": ic.hits,
+                      "infer_cache_misses": ic.misses}))
     return 0
 
 
@@ -245,6 +261,8 @@ def cmd_predict(args) -> int:
     import numpy as np
 
     from deeplearning4j_tpu.cli.schemes import load_input
+    from deeplearning4j_tpu.datasets.iterator import (ListDataSetIterator,
+                                                      PrefetchIterator)
 
     net = _load_model(args.model)
     data = load_input(args.input, label_column=args.label_column,
@@ -253,7 +271,16 @@ def cmd_predict(args) -> int:
         data = data.normalize_zero_mean_unit_variance()
     if getattr(args, "scale_01", False):
         data = data.scale_to_unit()
-    probs = np.asarray(net.output(data.features))
+    if 0 < args.batch < data.num_examples():
+        # fixed-size buckets through the serve-path compile cache; the
+        # ragged tail pads into the full-batch bucket, and prefetch
+        # overlaps each batch's host->device copy with the previous
+        # batch's forward pass
+        probs = np.concatenate(
+            [np.asarray(net.output(b.features))
+             for b in PrefetchIterator(ListDataSetIterator(data, args.batch))])
+    else:
+        probs = np.asarray(net.output(data.features))
     preds = probs.argmax(axis=-1)
     if args.output:
         with open(args.output, "w", newline="") as f:
@@ -262,7 +289,12 @@ def cmd_predict(args) -> int:
                        [f"p{i}" for i in range(probs.shape[1])])
             for p, row in zip(preds, probs):
                 w.writerow([int(p)] + [f"{v:.6f}" for v in row])
-        print(json.dumps({"written": args.output, "n": len(preds)}))
+        ic = net.infer_cache.stats
+        print(json.dumps({"written": args.output, "n": len(preds),
+                          "infer_compile_seconds": round(
+                              ic.total_compile_seconds, 3),
+                          "infer_cache_hits": ic.hits,
+                          "infer_cache_misses": ic.misses}))
     else:
         print(" ".join(str(int(p)) for p in preds))
     return 0
@@ -302,11 +334,19 @@ def build_parser() -> argparse.ArgumentParser:
 
     te = sub.add_parser("test", help="evaluate a checkpoint")
     _add_common(te)
+    te.add_argument("--batch", type=int, default=1024,
+                    help="evaluation batch rows (0 = one giant device "
+                         "call); batches share one compiled program per "
+                         "shape bucket and prefetch one batch ahead")
     te.set_defaults(fn=cmd_test)
 
     pr = sub.add_parser("predict", help="write predictions for a dataset")
     _add_common(pr)
     pr.add_argument("--output", default=None, help="predictions CSV path")
+    pr.add_argument("--batch", type=int, default=1024,
+                    help="prediction batch rows (0 = one giant device "
+                         "call); batches share one compiled program per "
+                         "shape bucket and prefetch one batch ahead")
     pr.set_defaults(fn=cmd_predict)
     return ap
 

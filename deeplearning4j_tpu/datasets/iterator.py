@@ -10,7 +10,9 @@ totalExamples(), inputColumns(), reset(), cursor) and the wrappers in
 
 from __future__ import annotations
 
-from typing import Iterator, List, Optional
+import queue
+import threading
+from typing import Iterator, List, NamedTuple, Optional
 
 import numpy as np
 
@@ -73,9 +75,14 @@ class ListDataSetIterator(DataSetIterator):
         return self.data.num_outcomes()
 
     def next(self, num: Optional[int] = None) -> DataSet:
-        n = num or self.batch_size
+        # `if num is None`, not `num or ...`: a falsy num=0 must mean an
+        # empty batch, not silently substitute the full batch size
+        n = self.batch_size if num is None else num
         out = self.data.get(slice(self.cursor, self.cursor + n))
-        self.cursor += n
+        # advance by the rows actually served, so a ragged final slice
+        # reports its true length (prefetch bucket selection and cursor
+        # accounting key on real rows, not the requested batch size)
+        self.cursor += out.num_examples()
         return out
 
 
@@ -95,7 +102,7 @@ class SamplingDataSetIterator(DataSetIterator):
         return self.data.num_outcomes()
 
     def next(self, num: Optional[int] = None) -> DataSet:
-        n = num or self.batch_size
+        n = self.batch_size if num is None else num
         idx = self._rng.choice(self.data.num_examples(), size=n)
         self.cursor += n
         return self.data.get(idx)
@@ -130,8 +137,9 @@ class MultipleEpochsIterator(DataSetIterator):
         if not self.base.has_next():
             self.base.reset()
             self._epoch += 1
-        self.cursor += num or self.batch_size
-        return self.base.next(num)
+        out = self.base.next(num)
+        self.cursor += out.num_examples()
+        return out
 
 
 class ReconstructionDataSetIterator(DataSetIterator):
@@ -238,3 +246,146 @@ class TestDataSetIterator(DataSetIterator):
         self.served.append(d)
         self.cursor = self.base.cursor
         return d
+
+
+class DeviceBatch(NamedTuple):
+    """A (features, labels) pair already resident on (or in flight to)
+    the device.  Quacks like a DataSet for every training/eval consumer
+    (`MultiLayerNetwork._as_batches`, the bucketed eval loop) without
+    `DataSet.__init__`'s `np.asarray`, which would drag the arrays back
+    to the host."""
+
+    features: object
+    labels: object
+
+    def num_examples(self) -> int:
+        return int(self.features.shape[0])
+
+
+class PrefetchIterator:
+    """Async host→device input pipeline (ROADMAP: host-side prefetch).
+
+    Wraps any iterable of batches — a `DataSetIterator`, a list of
+    `DataSet`s, or a generator of (features, labels) pairs — and runs
+    `jax.device_put` one or more batches AHEAD of the consumer on a
+    background thread, so the compiled train step / bucketed eval loop
+    never waits on host→device transfer (the input-feed stall Jouppi et
+    al. single out as the top non-compute cost on TPU serving).
+
+    Design:
+      - bounded queue (`buffer_batches`) so prefetch never races more
+        than a few batches of HBM ahead of the consumer;
+      - the worker parks on a timed `put` and re-checks a stop event, so
+        an early `break` / `close()` can never deadlock it against a
+        full queue;
+      - worker exceptions are caught, queued in order, and re-raised at
+        the consumer's matching `next()` — batches already produced are
+        still served first;
+      - `close()` (also via context manager / generator finalization)
+        shuts the worker down and joins it.
+
+    Iterating again after exhaustion or `close()` restarts the pipeline
+    (resetting the underlying iterator when it supports `reset()`).
+    """
+
+    _DONE = "done"
+    _ERROR = "error"
+    _ITEM = "item"
+
+    def __init__(self, base, buffer_batches: int = 2, device=None,
+                 to_device: bool = True):
+        self.base = base
+        self.buffer_batches = max(1, int(buffer_batches))
+        self.device = device
+        self.to_device = to_device
+        self._queue: Optional[queue.Queue] = None
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+
+    # -- transfer ----------------------------------------------------------
+    def _transfer(self, item):
+        if not self.to_device:
+            return item
+        import jax
+
+        put = (jax.device_put if self.device is None
+               else lambda a: jax.device_put(a, self.device))
+        if hasattr(item, "features") and hasattr(item, "labels"):
+            return DeviceBatch(put(item.features), put(item.labels))
+        if isinstance(item, tuple):
+            return tuple(put(a) for a in item)
+        return put(item)
+
+    # -- worker ------------------------------------------------------------
+    def _put(self, q: queue.Queue, stop: threading.Event, msg) -> bool:
+        """Queue `msg`, parking in bounded slices so a stopped consumer
+        releases the worker instead of deadlocking it against a full
+        queue."""
+        while not stop.is_set():
+            try:
+                q.put(msg, timeout=0.05)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def _worker(self, q: queue.Queue, stop: threading.Event) -> None:
+        try:
+            for item in self.base:
+                if stop.is_set():
+                    return
+                if not self._put(q, stop, (self._ITEM, self._transfer(item))):
+                    return
+            self._put(q, stop, (self._DONE, None))
+        except BaseException as e:  # noqa: BLE001 — re-raised at next()
+            self._put(q, stop, (self._ERROR, e))
+
+    def _start(self) -> None:
+        self.close()  # tear down any previous run
+        if hasattr(self.base, "reset"):
+            self.base.reset()
+        self._stop = threading.Event()
+        self._queue = queue.Queue(maxsize=self.buffer_batches)
+        self._thread = threading.Thread(
+            target=self._worker, args=(self._queue, self._stop),
+            name="dl4j-prefetch", daemon=True)
+        self._thread.start()
+
+    # -- consumer ----------------------------------------------------------
+    def __iter__(self):
+        self._start()
+        try:
+            while True:
+                kind, payload = self._queue.get()
+                if kind == self._DONE:
+                    break
+                if kind == self._ERROR:
+                    raise payload
+                yield payload
+        finally:
+            self.close()
+
+    def reset(self) -> None:
+        """DataSetIterator-style reset: stop the pipeline; the next
+        iteration restarts it (and resets the wrapped iterator)."""
+        self.close()
+
+    def close(self) -> None:
+        """Stop the worker and join it (idempotent; safe mid-iteration)."""
+        self._stop.set()
+        thread, self._thread = self._thread, None
+        if thread is not None:
+            # drain so a worker parked on a full queue sees the stop flag
+            while thread.is_alive():
+                try:
+                    self._queue.get_nowait()
+                except queue.Empty:
+                    pass
+                thread.join(timeout=0.05)
+        self._queue = None
+
+    def __enter__(self) -> "PrefetchIterator":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

@@ -1,3 +1,5 @@
-"""evaluation — classifier metrics (reference `eval/` parity)."""
+"""evaluation — classifier metrics (reference `eval/` parity) plus the
+bucketed/prefetched iterator evaluation loop (`evaluate`)."""
 
-from deeplearning4j_tpu.evaluation.evaluation import ConfusionMatrix, Evaluation
+from deeplearning4j_tpu.evaluation.evaluation import (ConfusionMatrix,
+                                                      Evaluation, evaluate)

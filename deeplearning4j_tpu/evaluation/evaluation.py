@@ -114,3 +114,39 @@ class Evaluation:
             str(self.confusion),
         ]
         return "\n".join(lines)
+
+
+def evaluate(net, data, batch_size: int = 0, prefetch: bool = True,
+             evaluation: Optional[Evaluation] = None) -> Evaluation:
+    """Evaluate `net` over batches instead of one giant device call.
+
+    `data` may be a `DataSet`, a `DataSetIterator`, or any iterable of
+    batches with `.features`/`.labels`; a `DataSet` plus `batch_size > 0`
+    is sliced into fixed-size batches.  Each batch's `net.output` goes
+    through the serve-path AOT compile cache (`optimize/infer_cache.py`):
+    full batches share ONE bucket program and the ragged tail zero-pads
+    into it, so a whole evaluation epoch compiles at most once per bucket
+    instead of tracing a one-off giant graph.  With `prefetch=True` a
+    background thread runs `jax.device_put` one batch ahead
+    (`datasets.iterator.PrefetchIterator`), overlapping host→device
+    transfer with the device's argmax/output compute.
+
+    Counting is exact host-side integer math either way, so the bucketed
+    result is identical to the single-call result (pad rows are sliced
+    off before the argmax ever reaches the confusion matrix).
+    """
+    from deeplearning4j_tpu.datasets.iterator import (ListDataSetIterator,
+                                                      PrefetchIterator)
+
+    if hasattr(data, "features") and hasattr(data, "labels") and \
+            not hasattr(data, "__next__"):
+        batches = (ListDataSetIterator(data, batch_size)
+                   if 0 < batch_size < data.num_examples() else [data])
+    else:
+        batches = data
+    if prefetch:
+        batches = PrefetchIterator(batches)
+    ev = evaluation if evaluation is not None else Evaluation()
+    for batch in batches:
+        ev.eval(np.asarray(batch.labels), np.asarray(net.output(batch.features)))
+    return ev

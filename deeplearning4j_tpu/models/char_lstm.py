@@ -65,10 +65,16 @@ class CharLSTM:
         x, y = eye[xs], eye[ys]
         bs = self.batch_size
         if bs and bs < n_win:
+            from deeplearning4j_tpu.datasets.dataset import DataSet
+            from deeplearning4j_tpu.datasets.iterator import PrefetchIterator
+
             t = self.seq_len  # label rows are window-major blocks of T
-            for s in range(0, n_win, bs):
-                xb = x[s:s + bs]
-                self.net.fit(xb, y[s * t:(s + xb.shape[0]) * t])
+            batches = [DataSet(x[s:s + bs],
+                               y[s * t:(s + min(bs, n_win - s)) * t])
+                       for s in range(0, n_win, bs)]
+            # async input pipeline: each window batch device_puts one
+            # step ahead of the compiled train step it feeds
+            self.net.fit(PrefetchIterator(batches))
         else:
             self.net.fit(x, y)
         return self

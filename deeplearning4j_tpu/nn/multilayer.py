@@ -33,6 +33,7 @@ from deeplearning4j_tpu.nn.conf import (LayerType, MultiLayerConfiguration,
 from deeplearning4j_tpu.nn.layers import get_layer
 from deeplearning4j_tpu.nn.layers.preprocessor import apply_preprocessor
 from deeplearning4j_tpu.optimize import solver as solver_mod
+from deeplearning4j_tpu.optimize.infer_cache import InferCache
 from deeplearning4j_tpu.optimize.listeners import dispatch as dispatch_listeners
 from deeplearning4j_tpu.optimize.step_cache import TrainStepCache
 
@@ -316,6 +317,12 @@ class MultiLayerNetwork:
         # use_step_cache=False restores the legacy retrace-per-batch path.
         self.step_cache = TrainStepCache()
         self.use_step_cache = True
+        # serve-path sibling: one AOT-compiled program per (conf, entry
+        # point, shape bucket) for output/score/feed_forward — repeated
+        # serving calls at a seen shape never re-trace.
+        # use_infer_cache=False restores the legacy retrace-per-call path.
+        self.infer_cache = InferCache()
+        self.use_infer_cache = True
         self._bn_in_step = False  # did the last finetune advance BN EMA?
 
     # -- lifecycle ---------------------------------------------------------
@@ -331,18 +338,33 @@ class MultiLayerNetwork:
         self.listeners = list(listeners)
 
     # -- inference ---------------------------------------------------------
+    def _serve_cached(self, x) -> bool:
+        """Serve-path cache eligibility: batched input (axis 0 = rows is
+        what bucketing pads) and the cache switched on."""
+        return self.use_infer_cache and getattr(x, "ndim", 0) >= 2
+
     def feed_forward(self, x):
-        return feed_forward(self.conf, self.params, jnp.asarray(x))
+        x = jnp.asarray(x)
+        if self._serve_cached(x):
+            return self.infer_cache.feed_forward(self.conf, self.params, x)
+        return feed_forward(self.conf, self.params, x)
 
     def output(self, x):
-        return network_output(self.conf, self.params, jnp.asarray(x))
+        x = jnp.asarray(x)
+        if self._serve_cached(x):
+            return self.infer_cache.output(self.conf, self.params, x)
+        return network_output(self.conf, self.params, x)
 
     def predict(self, x):
         return np.asarray(jnp.argmax(self.output(x), axis=-1))
 
     def score(self, x, labels) -> float:
-        return float(network_loss(self.conf, self.params, jnp.asarray(x),
-                                  jnp.asarray(labels), key=None, training=False))
+        x, labels = jnp.asarray(x), jnp.asarray(labels)
+        if self._serve_cached(x):
+            return float(self.infer_cache.loss(self.conf, self.params, x,
+                                               labels))
+        return float(network_loss(self.conf, self.params, x, labels,
+                                  key=None, training=False))
 
     def f1_score(self, x, labels) -> float:
         """Classification F1 on (x, labels) — the reference's
@@ -353,6 +375,17 @@ class MultiLayerNetwork:
         ev = Evaluation()
         ev.eval(jnp.asarray(labels), self.output(x))
         return float(ev.f1())
+
+    def evaluate(self, data, labels=None, batch_size: int = 0,
+                 prefetch: bool = True):
+        """Bucketed, prefetched evaluation — see `evaluation.evaluate`."""
+        from deeplearning4j_tpu.evaluation import evaluate
+
+        if labels is not None:
+            from deeplearning4j_tpu.datasets.dataset import DataSet
+
+            data = DataSet(np.asarray(data), np.asarray(labels))
+        return evaluate(self, data, batch_size=batch_size, prefetch=prefetch)
 
     # -- training ----------------------------------------------------------
     def _finetune_objective(self, x, labels):
@@ -422,14 +455,14 @@ class MultiLayerNetwork:
         Default path: the compiled step cache — batch data enters the
         solver program as jit arguments, so a (conf, batch-shape) pair
         compiles once and every further batch is a cache hit.  BatchNorm
-        EMA advances inside the compiled step.  Hessian-free keeps the
-        legacy closure path (its Gauss-Newton product runs `predict` over
-        all rows, which the pad mask cannot reach)."""
+        EMA advances inside the compiled step.  Hessian-free rides the
+        same cache: its Gauss-Newton product threads the pad-row weight
+        mask through the loss-of-outputs half
+        (`solver.weighted_predict_loss`), so HF programs share the
+        bucketed padding too."""
         x, labels = jnp.asarray(x), jnp.asarray(labels)
         out_conf = self.conf.conf(self.conf.n_layers - 1)
-        algo = OptimizationAlgorithm(str(out_conf.optimization_algo))
-        if (self.use_step_cache
-                and algo != OptimizationAlgorithm.HESSIAN_FREE):
+        if self.use_step_cache:
             self.params, scores = self.step_cache.finetune(
                 self.conf, self.params, x, labels, self._next_key())
             self._bn_in_step = has_batchnorm(self.conf)

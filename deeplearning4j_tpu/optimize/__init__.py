@@ -13,3 +13,5 @@ compiles to a single XLA executable with zero host round-trips.
 
 from deeplearning4j_tpu.optimize.solver import Solver, optimize
 from deeplearning4j_tpu.optimize.updater import UpdaterState, init_updater, adjust_gradient
+from deeplearning4j_tpu.optimize.step_cache import TrainStepCache
+from deeplearning4j_tpu.optimize.infer_cache import InferCache

@@ -1,0 +1,144 @@
+"""Serve-path AOT compile cache — inference compiles once, serves many.
+
+PR 1 (`optimize/step_cache.py`) gave the *training* step compile-once
+semantics, but the serve path still re-traced `network_output` /
+`network_loss` on every `output()` / `score()` call and every shape —
+exactly the per-call graph construction cost TensorFlow (Abadi et al.,
+2016) and the TPU datacenter analysis (Jouppi et al., 2017) identify as
+the dominant non-compute overhead of accelerator inference.
+
+`InferCache` reuses the `CompiledProgramCache` machinery:
+
+  key schema    (entry point in {output, loss, feed_forward},
+                 conf fingerprint, arg shapes/dtypes) -> AOT executable.
+  batch args    (params, x[, y, w]) are explicit jit arguments — params
+                 can keep training between serve calls without retraces.
+  bucketing     ragged final batches zero-pad up to the smallest known
+                 row bucket; `output`/`feed_forward` slice the pad rows
+                 back off (inference is row-independent, so real rows
+                 are bit-identical), and `loss` masks pad rows out of
+                 the weighted mean via the same gemm-contraction form as
+                 training (`dot(rows, w)` is bit-invariant to trailing
+                 zero-weight rows) — padded evaluation matches unpadded
+                 evaluation bit-for-bit in f32.
+  no donation   unlike the train cache, inference programs NEVER donate
+                 their params buffer: the same params serve every call.
+  observability `cache.stats` (hits / misses / steps / compile seconds)
+                 sits alongside the train cache's stats; the CLI
+                 `test`/`predict` commands report it in their JSON.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Tuple
+
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.optimize.step_cache import (CompiledProgramCache,
+                                                    arg_signature)
+
+
+def pad_rows(x, bucket: int):
+    """Zero-pad `x` with rows up to `bucket` (feature rows = axis 0)."""
+    pad = bucket - x.shape[0]
+    if pad:
+        x = jnp.concatenate([x, jnp.zeros((pad,) + x.shape[1:], x.dtype)])
+    return x
+
+
+def truncate_rows(arr, bucket: int, n: int):
+    """Slice a program output back to the `n` real input rows.
+
+    Activations may carry `bucket` rows or a whole multiple (B*T rows
+    for sequence stages whose rnn_to_ff preprocessor flattened time into
+    the batch); pad batch entries occupy the trailing block either way.
+    Outputs whose leading dim is not tied to the batch pass through."""
+    if getattr(arr, "ndim", 0) and arr.shape[0] and arr.shape[0] % bucket == 0:
+        ratio = arr.shape[0] // bucket
+        return arr[: n * ratio]
+    return arr
+
+
+class InferCache(CompiledProgramCache):
+    """Keyed AOT-compile cache for the inference entry points."""
+
+    kind = "infer-cache"
+
+    def _donate_argnums(self) -> Tuple[int, ...]:
+        # serve-path params are reused by every subsequent call (and by
+        # training) — donation would invalidate live buffers
+        return ()
+
+    # -- entry points -------------------------------------------------------
+    def output(self, conf, params, x):
+        """`network_output` through the cache: returns the output
+        activations for the `x.shape[0]` real rows."""
+        n = int(x.shape[0])
+        bucket = self.bucket_rows(n)
+        xp = pad_rows(x, bucket)
+        key = ("output", self._fingerprint(conf), arg_signature(xp))
+        args = (params, xp)
+        fn = self._get(key, lambda: _output_program(conf), args)
+        self.stats.steps += 1
+        return truncate_rows(fn(*args), bucket, n)
+
+    def feed_forward(self, conf, params, x):
+        """`feed_forward` through the cache: the per-layer activation
+        list, each sliced back to the real rows."""
+        n = int(x.shape[0])
+        bucket = self.bucket_rows(n)
+        xp = pad_rows(x, bucket)
+        key = ("feed_forward", self._fingerprint(conf), arg_signature(xp))
+        args = (params, xp)
+        fn = self._get(key, lambda: _feed_forward_program(conf), args)
+        self.stats.steps += 1
+        return [truncate_rows(a, bucket, n) for a in fn(*args)]
+
+    def loss(self, conf, params, x, y):
+        """`network_loss(training=False)` through the cache: the
+        row-weighted mean loss over the real rows plus regularization.
+        Pad rows carry weight 0 and the mean is a gemm contraction, so a
+        bucket-padded tail scores bit-identically to the unpadded batch."""
+        n = int(x.shape[0])
+        bucket = self.bucket_rows(n)
+        xp, yp, w = self.pad_batch(x, y, bucket)
+        key = ("loss", self._fingerprint(conf), arg_signature(xp, yp, w))
+        args = (params, xp, yp, w)
+        fn = self._get(key, lambda: _loss_program(conf), args)
+        self.stats.steps += 1
+        return fn(*args)
+
+
+def _output_program(conf) -> Callable:
+    # local import: nn.multilayer imports this module at top level
+    from deeplearning4j_tpu.nn.multilayer import network_output
+
+    def program(params, x):
+        return network_output(conf, params, x, key=None, training=False)
+
+    return program
+
+
+def _feed_forward_program(conf) -> Callable:
+    from deeplearning4j_tpu.nn.multilayer import feed_forward
+
+    def program(params, x):
+        return tuple(feed_forward(conf, params, x, key=None, training=False))
+
+    return program
+
+
+def _loss_program(conf) -> Callable:
+    from deeplearning4j_tpu.nn.multilayer import (network_regularization,
+                                                  network_rowwise_loss)
+
+    def program(params, x, y, w):
+        rows = network_rowwise_loss(conf, params, x, y, key=None,
+                                    training=False)
+        # dot, not mean: bit-invariant to trailing zero-weight pad rows
+        # (see make_finetune_loss / layers.base.rows_broadcast)
+        return (jnp.dot(rows, w)
+                / jnp.maximum(jnp.dot(w, jnp.ones_like(w)), 1.0)
+                + network_regularization(conf, params))
+
+    return program

@@ -134,6 +134,27 @@ def from_predict_loss(predict: Callable, loss_of_out: Callable) -> Objective:
     return Objective(grad_and_score=gs, score=loss_fn, gnvp=gnvp)
 
 
+def weighted_predict_loss(predict, rowwise_loss: Callable, labels,
+                          row_weights) -> Objective:
+    """`from_predict_loss` with a pad-row weight mask threaded through the
+    Gauss-Newton product (ROADMAP: cached Hessian-free).
+
+    loss_of_out is the row-weighted mean of `rowwise_loss(labels, z)` as a
+    gemm contraction (`dot(rows, w)`), the same bit-exact-under-padding
+    form `make_finetune_loss` uses: a pad row's weight is exactly 0, so
+    its contribution to the loss Hessian — and therefore to the curvature
+    cotangent entering the predict vjp — is an exact float zero, and a
+    zero-padded bucket batch produces the same Gauss-Newton products as
+    the unpadded batch."""
+
+    def loss_of_out(z):
+        rows = rowwise_loss(labels, z)
+        return jnp.dot(rows, row_weights) / jnp.maximum(
+            jnp.dot(row_weights, jnp.ones_like(row_weights)), 1.0)
+
+    return from_predict_loss(predict, loss_of_out)
+
+
 def make_termination(conf):
     """Build the termination predicate from conf (pluggable parity with
     `optimize/terminations/*`: EpsTermination, Norm2Termination,

@@ -38,9 +38,15 @@ Design:
                  per-key compile seconds; every miss is logged so
                  retraces are observable instead of silent.
 
-Hessian-free finetune stays on the uncached legacy path: its Gauss-Newton
-product evaluates `predict` over ALL rows, which a zero-pad mask cannot
-reach (ROADMAP open item).
+Hessian-free finetune joins the cache too: its Gauss-Newton product is
+built from `solver.weighted_predict_loss`, which threads the pad-row
+weight mask through the loss-of-outputs half of the product — pad rows
+carry exact-zero curvature cotangents, so HF programs share the bucketed
+padding (and its bit-exactness guarantee) with every other algorithm.
+
+The serve-path sibling of this module is `optimize/infer_cache.py`
+(`InferCache`): it reuses the `CompiledProgramCache` machinery below for
+the inference entry points (output / loss / feed_forward).
 """
 
 from __future__ import annotations
@@ -94,8 +100,14 @@ class StepCacheStats:
         return f"StepCacheStats({self.as_dict()})"
 
 
-class TrainStepCache:
-    """Memoizes AOT-compiled solver programs.
+class CompiledProgramCache:
+    """Shared compile-once machinery: keyed AOT programs, grow-on-demand
+    shape buckets, and hit/miss/compile-seconds stats.
+
+    `TrainStepCache` (below) and the serve-path `InferCache`
+    (`optimize/infer_cache.py`) are both thin entry-point layers over
+    this class — same key schema, same bucket policy, same
+    observability, different programs.
 
     donate: None = donate params on accelerator backends only (CPU XLA
     ignores donation with a warning); True/False force it.
@@ -103,6 +115,9 @@ class TrainStepCache:
     default buckets grow on demand from the batch sizes seen (full
     batches come first in practice, tails then pad up into them).
     """
+
+    #: label used in miss logs so train/infer retraces are distinguishable
+    kind = "program-cache"
 
     def __init__(self, donate: Optional[bool] = None,
                  buckets: Optional[Tuple[int, ...]] = None):
@@ -122,8 +137,9 @@ class TrainStepCache:
             if b >= n:
                 return b
         if self._fixed_buckets and self._buckets:
-            log.info("step-cache: batch of %d rows exceeds the fixed "
-                     "buckets %s; running unpadded", n, self._buckets)
+            log.info("%s: batch of %d rows exceeds the fixed "
+                     "buckets %s; running unpadded", self.kind, n,
+                     self._buckets)
         else:
             self._buckets.append(n)
             self._buckets.sort()
@@ -163,8 +179,8 @@ class TrainStepCache:
         fn = jitted.lower(*abstract).compile()
         dt = time.perf_counter() - t0
         self.stats.compile_seconds[key] = dt
-        log.info("step-cache miss: compiled %s in %.2fs (entry %d)",
-                 key, dt, len(self._programs) + 1)
+        log.info("%s miss: compiled %s in %.2fs (entry %d)",
+                 self.kind, key, dt, len(self._programs) + 1)
         self._programs[key] = fn
         return fn
 
@@ -193,6 +209,13 @@ class TrainStepCache:
             y = jnp.concatenate(
                 [y, jnp.zeros((pad * ratio,) + y.shape[1:], y.dtype)])
         return x, y, w
+
+
+class TrainStepCache(CompiledProgramCache):
+    """Memoizes AOT-compiled solver programs (the training entry points
+    over `CompiledProgramCache`)."""
+
+    kind = "step-cache"
 
     # -- network train steps ------------------------------------------------
     def finetune(self, conf, params, x, y, key):
@@ -238,13 +261,20 @@ class TrainStepCache:
 def _finetune_program(conf, collect_bn: bool) -> Callable:
     """Build the (uncompiled) finetune step: run the configured solver
     over explicit batch args, then fold the BatchNorm EMA advance into
-    the same program."""
+    the same program.  Hessian-free additionally gets a Gauss-Newton
+    product with the pad-row weight mask threaded through its
+    loss-of-outputs half (`solver.weighted_predict_loss`), so HF shares
+    the bucketed padding instead of the legacy closure path."""
     # local import: nn.multilayer imports this module at top level
+    from deeplearning4j_tpu.nn.conf import OptimizationAlgorithm
     from deeplearning4j_tpu.nn.multilayer import (make_finetune_loss,
+                                                  network_output,
                                                   update_bn_ema_from_stats)
 
     out_conf = conf.conf(conf.n_layers - 1)
     loss_and_stats = make_finetune_loss(conf, collect_bn=collect_bn)
+    is_hf = (OptimizationAlgorithm(str(out_conf.optimization_algo))
+             == OptimizationAlgorithm.HESSIAN_FREE)
 
     def program(params, x, y, w, key):
         if collect_bn:
@@ -261,6 +291,14 @@ def _finetune_program(conf, collect_bn: bool) -> Callable:
         else:
             objective = solver_mod.from_loss(
                 lambda p, k: loss_and_stats(p, x, y, w, k)[0])
+        if is_hf:
+            # factor as predict+loss for Gauss-Newton products (the
+            # reference's computeDeltasR R-op machinery); pad rows enter
+            # the product with weight 0 — exact-zero curvature cotangents
+            objective = objective._replace(
+                gnvp=solver_mod.weighted_predict_loss(
+                    lambda p, k: network_output(conf, p, x),
+                    _rowwise_output_loss(out_conf), y, w).gnvp)
         new_params, scores, aux = solver_mod.optimize_with_aux(
             objective, params, out_conf, key)
         if collect_bn:
@@ -268,6 +306,14 @@ def _finetune_program(conf, collect_bn: bool) -> Callable:
         return new_params, scores
 
     return program
+
+
+def _rowwise_output_loss(out_conf):
+    """The output layer's per-row loss `(labels, outputs) -> [rows]` for
+    the Gauss-Newton factorization."""
+    from deeplearning4j_tpu.nd.losses import get_rowwise
+
+    return get_rowwise(out_conf.loss_function)
 
 
 def _pretrain_program(layer_conf, impl) -> Callable:

@@ -1,0 +1,270 @@
+"""Serve-path AOT cache + prefetch pipeline: compile-once semantics,
+bucketed-pad bit-exactness, iterator evaluation, async prefetch behavior,
+cached Hessian-free parity, and the iterator num=0 regression."""
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.datasets.iterator import (ListDataSetIterator,
+                                                  PrefetchIterator)
+from deeplearning4j_tpu.evaluation import Evaluation, evaluate
+from deeplearning4j_tpu.models.zoo import mlp
+from deeplearning4j_tpu.nn.conf import (LayerType, NeuralNetConfiguration,
+                                        OptimizationAlgorithm, list_builder)
+from deeplearning4j_tpu.nn.multilayer import (MultiLayerNetwork,
+                                              network_output)
+
+
+def _data(n, n_in=6, n_out=3, seed=0):
+    rng = np.random.RandomState(seed)
+    x = rng.randn(n, n_in).astype(np.float32)
+    y = np.eye(n_out, dtype=np.float32)[rng.randint(0, n_out, n)]
+    return x, y
+
+
+def _net(seed=0, iters=2):
+    conf = mlp(n_in=6, hidden=[8], n_out=3, lr=0.05)
+    conf = conf.replace(confs=tuple(c.replace(num_iterations=iters)
+                                    for c in conf.confs))
+    return MultiLayerNetwork(conf, seed=seed).init()
+
+
+# -- compile-once semantics (acceptance criterion) --------------------------
+
+def test_repeated_output_compiles_once():
+    net = _net()
+    x, _ = _data(16)
+    outs = [np.asarray(net.output(x)) for _ in range(5)]
+    st = net.infer_cache.stats
+    assert st.misses == 1, st
+    assert st.hits == 4, st
+    for o in outs[1:]:
+        np.testing.assert_array_equal(outs[0], o)
+
+
+def test_output_and_score_miss_once_per_entry_point():
+    net = _net()
+    x, y = _data(16)
+    for _ in range(3):
+        net.output(x)
+        net.score(x, y)
+    st = net.infer_cache.stats
+    assert st.misses == 2, st          # one per entry point (output, loss)
+    assert st.hits == 4, st
+    assert len(net.infer_cache) == 2
+
+
+def test_training_between_serves_does_not_retrace():
+    """Params are jit ARGUMENTS: fit() between output() calls must hit."""
+    net = _net()
+    x, y = _data(16)
+    net.output(x)
+    net.fit(x, y)
+    net.output(x)
+    st = net.infer_cache.stats
+    assert st.misses == 1 and st.hits == 1, st
+
+
+def test_feed_forward_cached_matches_legacy():
+    net = _net()
+    x, _ = _data(12)
+    cached = net.feed_forward(x)
+    net.use_infer_cache = False
+    legacy = net.feed_forward(x)
+    net.use_infer_cache = True
+    assert len(cached) == len(legacy)
+    for c, l in zip(cached, legacy):
+        np.testing.assert_array_equal(np.asarray(c), np.asarray(l))
+    assert net.infer_cache.stats.misses == 1
+
+
+def test_unbatched_input_falls_back_to_legacy():
+    net = _net()
+    x, _ = _data(1)
+    out = net.output(x[0])             # 1-D input: no row axis to bucket
+    assert np.asarray(out).shape == (3,)
+    assert len(net.infer_cache) == 0
+
+
+def test_infer_cache_never_donates():
+    from deeplearning4j_tpu.optimize.infer_cache import InferCache
+
+    assert InferCache(donate=True)._donate_argnums() == ()
+
+
+# -- bucketed padding bit-exactness (acceptance criterion) ------------------
+
+def test_padded_tail_output_bitexact_vs_unpadded():
+    """A 10-row tail padded into the 16-bucket must produce bit-identical
+    activations for the real rows (inference is row-independent)."""
+    net = _net()
+    x, _ = _data(16)
+    net.output(x)                       # seed the 16 bucket
+    tail = x[:10]
+    padded = np.asarray(net.output(tail))
+    assert net.infer_cache.stats.misses == 1  # tail reused the 16 program
+    unpadded = np.asarray(network_output(net.conf, net.params,
+                                         jnp.asarray(tail)))
+    np.testing.assert_array_equal(padded, unpadded)
+
+
+def test_padded_tail_score_bitexact_vs_unpadded():
+    """Pad rows carry weight 0 and the mean is a gemm contraction, so the
+    bucket-padded score equals the exactly-shaped score bit-for-bit."""
+    net = _net()
+    x, y = _data(16)
+    net.score(x, y)                     # seed the 16 bucket
+    padded = net.score(x[:10], y[:10])
+    assert net.infer_cache.stats.misses == 1
+
+    fresh = _net()                      # same seed: identical params
+    unpadded = fresh.score(x[:10], y[:10])  # its own exact 10-row bucket
+    assert padded == unpadded           # f32 bit-for-bit
+
+
+def test_bucketed_evaluate_matches_single_call():
+    x, y = _data(50)
+    net = _net()
+    whole = Evaluation()
+    whole.eval(y, np.asarray(net.output(x)))
+
+    bucketed = evaluate(net, DataSet(x, y), batch_size=16)
+    assert bucketed.accuracy() == whole.accuracy()
+    assert bucketed.f1() == whole.f1()
+    np.testing.assert_array_equal(bucketed.confusion.to_array(),
+                                  whole.confusion.to_array())
+    # 50 rows @ 16 = three full batches + a 2-row tail padded into the
+    # 16 bucket: ONE output program total
+    assert net.infer_cache.stats.misses == 1
+
+
+def test_net_evaluate_wraps_arrays_and_iterators():
+    x, y = _data(30)
+    net = _net()
+    ev_arrays = net.evaluate(x, y, batch_size=8, prefetch=False)
+    ev_iter = net.evaluate(ListDataSetIterator(DataSet(x, y), 8))
+    assert ev_arrays.accuracy() == ev_iter.accuracy()
+
+
+# -- prefetch pipeline (acceptance criterion: ordering, errors, shutdown) ---
+
+def _batches(n_batches=4, rows=8):
+    return [DataSet(*_data(rows, seed=i)) for i in range(n_batches)]
+
+
+def test_prefetch_preserves_order_and_values():
+    data = _batches()
+    served = list(PrefetchIterator(data, to_device=False))
+    assert len(served) == len(data)
+    for d, s in zip(data, served):
+        np.testing.assert_array_equal(d.features, s.features)
+        np.testing.assert_array_equal(d.labels, s.labels)
+
+
+def test_prefetch_device_put_yields_device_batches():
+    served = list(PrefetchIterator(_batches(2)))
+    for s in served:
+        assert isinstance(s.features, jax.Array)
+        assert s.num_examples() == 8
+
+
+def test_prefetch_propagates_worker_exception_in_order():
+    def gen():
+        yield DataSet(*_data(4, seed=0))
+        yield DataSet(*_data(4, seed=1))
+        raise RuntimeError("source went away")
+
+    it = PrefetchIterator(gen(), to_device=False)
+    served = []
+    with pytest.raises(RuntimeError, match="source went away"):
+        for d in it:
+            served.append(d)
+    assert len(served) == 2             # batches before the error still serve
+    assert it._thread is None           # worker joined by the finally-close
+
+
+def test_prefetch_early_break_shuts_down_without_deadlock():
+    it = PrefetchIterator(_batches(50), buffer_batches=1, to_device=False)
+    for i, _ in enumerate(it):
+        if i == 1:
+            break                       # generator finalization -> close()
+    t0 = time.perf_counter()
+    it.close()                          # idempotent; must not hang
+    assert time.perf_counter() - t0 < 5.0
+    assert it._thread is None
+
+
+def test_prefetch_restarts_after_exhaustion():
+    base = ListDataSetIterator(DataSet(*_data(20)), 8)
+    it = PrefetchIterator(base, to_device=False)
+    first = [d.num_examples() for d in it]
+    second = [d.num_examples() for d in it]   # close() + base.reset()
+    assert first == second == [8, 8, 4]
+
+
+def test_fit_accepts_prefetch_iterator():
+    net = _net()
+    data = _batches(3, rows=8)
+    net.fit(PrefetchIterator(data))
+    assert net.step_cache.stats.steps == 3
+    assert net.step_cache.stats.misses == 1   # equal shapes: one program
+
+
+# -- cached Hessian-free (satellite) ----------------------------------------
+
+def _hf_net(seed=3):
+    base = NeuralNetConfiguration(
+        optimization_algo=OptimizationAlgorithm.HESSIAN_FREE,
+        activation="tanh", num_iterations=4, lr=0.1, seed=seed,
+        hf_cg_iterations=8)
+    conf = (list_builder(base, 2).hidden_layer_sizes([8], n_in=6, n_out=3)
+            .override(1, layer_type=LayerType.OUTPUT).build())
+    return MultiLayerNetwork(conf, seed=seed).init()
+
+
+def test_hf_cached_matches_legacy_numerics():
+    x, y = _data(16, seed=5)
+    cached, legacy = _hf_net(), _hf_net()
+    legacy.use_step_cache = False
+    cached.fit(x, y)
+    legacy.fit(x, y)
+    assert cached.step_cache.stats.misses == 1
+    assert legacy.step_cache.stats.steps == 0
+    for pc, pl in zip(cached.params, legacy.params):
+        for k in pc:
+            np.testing.assert_allclose(np.asarray(pc[k]), np.asarray(pl[k]),
+                                       rtol=1e-5, atol=1e-6)
+
+
+def test_hf_padded_tail_reuses_bucket_and_trains():
+    net = _hf_net()
+    x, y = _data(16, seed=5)
+    before = net.score(x, y)
+    net.fit(x, y)                       # seeds the 16 bucket
+    net.fit(x[:11], y[:11])             # ragged tail pads into it
+    assert net.step_cache.stats.misses == 1
+    assert net.step_cache.stats.steps == 2
+    assert net.score(x, y) < before
+
+
+# -- iterator regressions (satellite) ---------------------------------------
+
+def test_list_iterator_next_zero_returns_empty_batch():
+    it = ListDataSetIterator(DataSet(*_data(10)), 4)
+    empty = it.next(0)                  # falsy num must NOT mean "full batch"
+    assert empty.num_examples() == 0
+    assert it.cursor == 0
+    assert it.next().num_examples() == 4
+
+
+def test_list_iterator_ragged_tail_reports_true_length():
+    it = ListDataSetIterator(DataSet(*_data(10)), 4)
+    sizes = [it.next().num_examples() for _ in range(3)]
+    assert sizes == [4, 4, 2]
+    assert it.cursor == 10              # advanced by rows served, not by 12
+    assert not it.has_next()
