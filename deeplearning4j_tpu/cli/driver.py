@@ -45,6 +45,32 @@ def _load_model(model_dir):
     return net
 
 
+def _attach_compile_cache(net, args) -> None:
+    """--compile-cache DIR: persistent on-disk program store shared by
+    the train-step and serve-path caches (see optimize/persist.py)."""
+    if getattr(args, "compile_cache", None):
+        net.set_compile_cache(args.compile_cache)
+
+
+def _disk_stats(net) -> dict:
+    """Disk-cache stats block for the CLI JSON (zeros when no store is
+    attached, so the schema is stable either way)."""
+    cs, ic = net.step_cache.stats, net.infer_cache.stats
+    out = {
+        "disk_hits": cs.disk_hits + ic.disk_hits,
+        "disk_write_seconds": round(
+            cs.disk_write_seconds + ic.disk_write_seconds, 3),
+        "deserialize_seconds": round(
+            cs.deserialize_seconds + ic.deserialize_seconds, 3),
+    }
+    store = net.step_cache.persist or net.infer_cache.persist
+    if store is not None:
+        out["dir"] = store.directory
+        out["entries"] = len(store)
+        out["bytes"] = store.total_bytes()
+    return out
+
+
 def _zoo_conf(spec: str, data):
     """--zoo 'name[:k=v,...]' -> MultiLayerConfiguration, sized from the
     loaded dataset where needed (vocab for char models, dims for mlp)."""
@@ -155,6 +181,7 @@ def cmd_train(args) -> int:
         from deeplearning4j_tpu.parallel.mesh import make_mesh
 
         net = MultiLayerNetwork(conf).init()
+        _attach_compile_cache(net, args)
         n_dev = len(jax.devices())
         mesh = make_mesh({"dp": n_dev})
         batch = int(props.get("batch", "128"))
@@ -175,8 +202,14 @@ def cmd_train(args) -> int:
         trainer = DataParallelTrainer(
             net, mesh, mode=props.get("mode", "sync"))
         trainer.fit(data.batch_by(batch), epochs=epochs)
+        # multi-chip compiles are timed in the trainer's own program
+        # cache (track_jit); report those instead of the bypassed
+        # single-chip step cache
+        step_stats = trainer.compile_cache.stats
     else:
         net = MultiLayerNetwork(conf).init()
+        _attach_compile_cache(net, args)
+        step_stats = net.step_cache.stats
         if deep_ae and epochs > 0:
             # Hinton's recipe: pretrain + decoder unroll happen ONCE —
             # re-running them per epoch would overwrite the previous
@@ -218,7 +251,7 @@ def cmd_train(args) -> int:
                       data.features if reconstruction else data.labels)
     checkpoint.save(args.output, net.params, conf=conf,
                     metadata={"score": score, "input": args.input})
-    cs = net.step_cache.stats  # mesh runtime bypasses it: zeros
+    cs = step_stats  # trainer.compile_cache on mesh, net.step_cache locally
     ic = net.infer_cache.stats  # the final score() above serves from it
     print(json.dumps({"saved": args.output, "score": score,
                       "train_seconds": round(train_seconds, 3),
@@ -228,7 +261,8 @@ def cmd_train(args) -> int:
                       "cache_hits": cs.hits,
                       "cache_misses": cs.misses,
                       "infer_compile_seconds": round(
-                          ic.total_compile_seconds, 3)}))
+                          ic.total_compile_seconds, 3),
+                      "disk_cache": _disk_stats(net)}))
     return 0
 
 
@@ -237,6 +271,7 @@ def cmd_test(args) -> int:
     from deeplearning4j_tpu.evaluation import evaluate
 
     net = _load_model(args.model)
+    _attach_compile_cache(net, args)
     data = load_input(args.input, label_column=args.label_column,
                       num_examples=args.num_examples)
     if args.normalize:
@@ -253,7 +288,8 @@ def cmd_test(args) -> int:
                       "infer_compile_seconds": round(
                           ic.total_compile_seconds, 3),
                       "infer_cache_hits": ic.hits,
-                      "infer_cache_misses": ic.misses}))
+                      "infer_cache_misses": ic.misses,
+                      "disk_cache": _disk_stats(net)}))
     return 0
 
 
@@ -265,6 +301,7 @@ def cmd_predict(args) -> int:
                                                       PrefetchIterator)
 
     net = _load_model(args.model)
+    _attach_compile_cache(net, args)
     data = load_input(args.input, label_column=args.label_column,
                       num_examples=args.num_examples)
     if args.normalize:
@@ -294,9 +331,46 @@ def cmd_predict(args) -> int:
                           "infer_compile_seconds": round(
                               ic.total_compile_seconds, 3),
                           "infer_cache_hits": ic.hits,
-                          "infer_cache_misses": ic.misses}))
+                          "infer_cache_misses": ic.misses,
+                          "disk_cache": _disk_stats(net)}))
     else:
         print(" ".join(str(int(p)) for p in preds))
+    return 0
+
+
+def cmd_warmup(args) -> int:
+    """Precompile declared shape buckets into a persistent compile cache
+    so a later serving/training process starts from disk hits instead of
+    multi-second compiles."""
+    import os
+
+    from deeplearning4j_tpu.nn.conf import MultiLayerConfiguration
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+    if not args.compile_cache:
+        raise SystemExit("warmup requires --compile-cache <dir>")
+    if args.model and os.path.isdir(args.model):
+        net = _load_model(args.model)
+    elif args.model:
+        with open(args.model) as f:
+            conf = MultiLayerConfiguration.from_json(f.read())
+        net = MultiLayerNetwork(conf).init()
+    else:
+        raise SystemExit("warmup needs --model <conf.json | checkpoint dir>")
+    net.set_compile_cache(args.compile_cache)
+    shapes = []
+    for spec in args.shapes.split(","):
+        spec = spec.strip()
+        if not spec:
+            continue
+        dims = tuple(int(d) for d in spec.split("x"))
+        shapes.append(dims[0] if len(dims) == 1 else dims)
+    if not shapes:
+        raise SystemExit("warmup needs --shapes (e.g. 256,1024 or 32x784)")
+    entries = tuple(e.strip() for e in args.entries.split(",") if e.strip())
+    summary = net.warmup(shapes, entries=entries, train=args.train)
+    summary["disk_cache"] = _disk_stats(net)
+    print(json.dumps(summary))
     return 0
 
 
@@ -313,6 +387,12 @@ def _add_common(p: argparse.ArgumentParser) -> None:
                         "visible units)")
     p.add_argument("--normalize", action="store_true",
                    help="zero-mean/unit-variance features")
+    p.add_argument("--compile-cache", dest="compile_cache", default=None,
+                   metavar="DIR",
+                   help="persistent on-disk compile cache: programs "
+                        "compiled by this run are reused by every later "
+                        "run pointed at the same directory (see the "
+                        "warmup subcommand to prefill it)")
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -348,6 +428,23 @@ def build_parser() -> argparse.ArgumentParser:
                          "call); batches share one compiled program per "
                          "shape bucket and prefetch one batch ahead")
     pr.set_defaults(fn=cmd_predict)
+
+    w = sub.add_parser("warmup",
+                       help="precompile shape buckets into a persistent "
+                            "compile cache ahead of traffic")
+    w.add_argument("--model", required=True,
+                   help="conf JSON or checkpoint dir to warm up")
+    w.add_argument("--compile-cache", dest="compile_cache", required=True,
+                   metavar="DIR", help="cache directory to populate")
+    w.add_argument("--shapes", default="1024",
+                   help="comma-separated batch sizes or full input shapes "
+                        "('x'-separated dims): 256,1024 or 32x1x28x28")
+    w.add_argument("--entries", default="output",
+                   help="serve entry points to compile: "
+                        "output,feed_forward,loss")
+    w.add_argument("--train", action="store_true",
+                   help="also compile the train step for each shape")
+    w.set_defaults(fn=cmd_warmup)
     return ap
 
 

@@ -136,6 +136,28 @@ class BatchNormLayer:
             s2 = (w1 @ (xf * xf))[0]
             cnt = (w1 @ jnp.ones((x.shape[0], 1), jnp.float32))[0, 0]
             return s1, s2, cnt
+        if x.ndim == 4:
+            # NCHW conv activations: same gemm-contraction trick, with
+            # channels as gemm rows and the flattened (n, h, w) positions
+            # as the contraction axis.  n is the SLOWEST-varying column
+            # index, so a batch zero-padded into a larger bucket only
+            # appends trailing zero-weight columns — the contraction (and
+            # its grads) stays bit-identical to the unpadded run.
+            n, c = x.shape[0], x.shape[1]
+            hw = x.shape[2] * x.shape[3]
+            if row_weights is None:
+                wv = jnp.ones((n * hw, 1), jnp.float32)
+            else:
+                # per-column weight = the column's batch-row weight,
+                # expanded over h*w via an exact rank-1 product
+                wv = (row_weights.reshape(-1, 1).astype(jnp.float32)
+                      @ jnp.ones((1, hw), jnp.float32)).reshape(-1, 1)
+            cols = xf.transpose(1, 0, 2, 3).reshape(c, n * hw)
+            s1 = (cols @ wv)[:, 0]
+            s2 = ((xf * xf).transpose(1, 0, 2, 3).reshape(c, n * hw)
+                  @ wv)[:, 0]
+            cnt = (jnp.ones((1, n * hw), jnp.float32) @ wv)[0, 0]
+            return s1, s2, cnt
         if row_weights is None:
             cnt = jnp.asarray(float(np.prod([x.shape[a] for a in axes])),
                               jnp.float32)
@@ -173,10 +195,19 @@ class BatchNormLayer:
         """Normalize x with the given stats + the layer's affine."""
         eps = 1e-5
         if x.ndim == 4:
-            mean = mean[None, :, None, None]
-            var = var[None, :, None, None]
-            gamma = params["gamma"][None, :, None, None]
-            beta = params["beta"][None, :, None, None]
+            # gemm-broadcast each per-channel vector over the (n, h, w)
+            # positions: value-identical to a plain broadcast, but the
+            # backward-pass batch reduction lowers as a gemm contraction
+            # with trailing pad columns — pad-invariant gamma/beta (and
+            # upstream mean/var) grads, mirroring the 2-D branch below
+            n, h, w = x.shape[0], x.shape[2], x.shape[3]
+
+            def bc(v):
+                return (rows_broadcast(v, n * h * w, x.dtype)
+                        .reshape(n, h, w, -1).transpose(0, 3, 1, 2))
+
+            mean, var = bc(mean), bc(var)
+            gamma, beta = bc(params["gamma"]), bc(params["beta"])
         elif x.ndim == 2:
             # gemm-broadcast every feature vector (pad-invariant grads for
             # gamma/beta and for whatever feeds mean/var — see rows_broadcast)

@@ -20,6 +20,7 @@ through the same solver machinery (`pretrain` flag parity).
 from __future__ import annotations
 
 import logging
+import os
 from functools import partial
 from typing import List, Optional, Sequence
 
@@ -324,6 +325,13 @@ class MultiLayerNetwork:
         self.infer_cache = InferCache()
         self.use_infer_cache = True
         self._bn_in_step = False  # did the last finetune advance BN EMA?
+        # persistent compile cache: DL4J_COMPILE_CACHE=<dir> attaches the
+        # on-disk program store to every network in the process, so
+        # restarts skip recompiles (the CLI's --compile-cache flag sets
+        # the same thing explicitly)
+        cache_dir = os.environ.get("DL4J_COMPILE_CACHE")
+        if cache_dir:
+            self.set_compile_cache(cache_dir)
 
     # -- lifecycle ---------------------------------------------------------
     def _next_key(self):
@@ -336,6 +344,73 @@ class MultiLayerNetwork:
 
     def set_listeners(self, listeners) -> None:
         self.listeners = list(listeners)
+
+    # -- persistent compile cache -------------------------------------------
+    def set_compile_cache(self, directory, max_bytes=None):
+        """Attach a persistent on-disk program store at `directory` to
+        both the train-step and serve-path caches (shared store, one key
+        schema): memory misses check disk before compiling, and fresh
+        compiles write back, so a restarted process skips every compile
+        a previous run already paid for.  Returns the store."""
+        from deeplearning4j_tpu.optimize.persist import PersistentProgramStore
+
+        kw = {} if max_bytes is None else {"max_bytes": max_bytes}
+        store = PersistentProgramStore(directory, **kw)
+        self.step_cache.set_persist(store)
+        self.infer_cache.set_persist(store)
+        return store
+
+    def warmup(self, shapes, entries=("output",), train=False):
+        """Precompile the serve/train programs for the given batch shapes
+        ahead of traffic, so the first real request is a cache hit.
+
+        `shapes`: iterable of batch sizes (int → (b, n_in)), full input
+        shapes (tuple), or example arrays.  `entries` picks the serve
+        entry points ("output", "feed_forward", "loss"); `train=True`
+        additionally compiles the train step for each shape.  With a
+        persistent store attached (`set_compile_cache`), warmup populates
+        the disk cache for every future process too.  Returns a summary
+        dict with the per-cache stats."""
+        if self.params is None:
+            self.init()
+        compiled = []
+        for spec in shapes:
+            if isinstance(spec, int):
+                x = jnp.zeros((spec, self.conf.confs[0].n_in), jnp.float32)
+            elif isinstance(spec, tuple):
+                x = jnp.zeros(spec, jnp.float32)
+            else:
+                x = jnp.asarray(spec)
+            y = None
+            if train or "loss" in entries:
+                out = jax.eval_shape(
+                    lambda p, xx: network_output(self.conf, p, xx, key=None,
+                                                 training=False),
+                    self.params, x)
+                y = jnp.zeros(out.shape, out.dtype)
+            for entry in entries:
+                if entry == "output":
+                    self.infer_cache.output(self.conf, self.params, x,
+                                            compile_only=True)
+                elif entry == "feed_forward":
+                    self.infer_cache.feed_forward(self.conf, self.params, x,
+                                                  compile_only=True)
+                elif entry == "loss":
+                    self.infer_cache.loss(self.conf, self.params, x, y,
+                                          compile_only=True)
+                else:
+                    raise ValueError(f"unknown warmup entry {entry!r}")
+            if train:
+                self.step_cache.finetune(self.conf, self.params, x, y,
+                                         self._key, compile_only=True)
+            compiled.append(tuple(x.shape))
+        return {
+            "shapes": compiled,
+            "entries": list(entries),
+            "train": bool(train),
+            "step_cache": self.step_cache.stats.as_dict(),
+            "infer_cache": self.infer_cache.stats.as_dict(),
+        }
 
     # -- inference ---------------------------------------------------------
     def _serve_cached(self, x) -> bool:

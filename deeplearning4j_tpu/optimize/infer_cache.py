@@ -70,19 +70,23 @@ class InferCache(CompiledProgramCache):
         return ()
 
     # -- entry points -------------------------------------------------------
-    def output(self, conf, params, x):
+    def output(self, conf, params, x, compile_only: bool = False):
         """`network_output` through the cache: returns the output
-        activations for the `x.shape[0]` real rows."""
+        activations for the `x.shape[0]` real rows.  compile_only=True
+        (warmup) registers the bucket and compiles — or disk-restores —
+        the program without executing it."""
         n = int(x.shape[0])
         bucket = self.bucket_rows(n)
         xp = pad_rows(x, bucket)
         key = ("output", self._fingerprint(conf), arg_signature(xp))
         args = (params, xp)
         fn = self._get(key, lambda: _output_program(conf), args)
+        if compile_only:
+            return None
         self.stats.steps += 1
         return truncate_rows(fn(*args), bucket, n)
 
-    def feed_forward(self, conf, params, x):
+    def feed_forward(self, conf, params, x, compile_only: bool = False):
         """`feed_forward` through the cache: the per-layer activation
         list, each sliced back to the real rows."""
         n = int(x.shape[0])
@@ -91,10 +95,12 @@ class InferCache(CompiledProgramCache):
         key = ("feed_forward", self._fingerprint(conf), arg_signature(xp))
         args = (params, xp)
         fn = self._get(key, lambda: _feed_forward_program(conf), args)
+        if compile_only:
+            return None
         self.stats.steps += 1
         return [truncate_rows(a, bucket, n) for a in fn(*args)]
 
-    def loss(self, conf, params, x, y):
+    def loss(self, conf, params, x, y, compile_only: bool = False):
         """`network_loss(training=False)` through the cache: the
         row-weighted mean loss over the real rows plus regularization.
         Pad rows carry weight 0 and the mean is a gemm contraction, so a
@@ -105,6 +111,8 @@ class InferCache(CompiledProgramCache):
         key = ("loss", self._fingerprint(conf), arg_signature(xp, yp, w))
         args = (params, xp, yp, w)
         fn = self._get(key, lambda: _loss_program(conf), args)
+        if compile_only:
+            return None
         self.stats.steps += 1
         return fn(*args)
 

@@ -1,0 +1,275 @@
+"""Persistent on-disk compile cache — programs survive the process.
+
+PR 1/2 made every (config, shape-bucket) pair lower to exactly one XLA
+program per process, but the programs died with the process: every
+restart of a trainer, eval job, or serving CLI re-paid the full
+trace+compile cost before its first batch.  Both the TPU paper (Jouppi
+et al., 2017) and TensorFlow's dataflow design (Abadi et al., 2016)
+treat the compiled program as a durable artifact reused across runs —
+this module makes the shared `CompiledProgramCache` do the same.
+
+Design:
+
+  export format  one entry = one file holding a small JSON header plus
+                 the `jax.export` serialization of the traced program
+                 (StableHLO).  Loading deserializes and AOT-compiles the
+                 exported module — the trace/lower cost (the dominant
+                 Python-side share of a cold start) is skipped entirely,
+                 and the executed program is byte-identical to what a
+                 fresh compile of the same key would run, because fresh
+                 compiles ALSO go through export (see
+                 `CompiledProgramCache._get`): disk-hit and fresh-compile
+                 steps match bit-for-bit.
+  key schema     entries reuse the caches' existing (kind, conf
+                 fingerprint, algorithm/entry, shapes/dtypes) key,
+                 extended with a PLATFORM fingerprint — backend, device
+                 kind, device count, jax/jaxlib versions, format version
+                 — folded into the filename hash AND revalidated from
+                 the header on load, so a stale or foreign artifact can
+                 never load: a mismatch is a plain miss that recompiles.
+  atomicity      writes go to a tmpfile in the cache directory and
+                 `os.replace` into place — concurrent writers (several
+                 serving processes warming the same directory) can never
+                 expose a torn file; last writer wins with identical
+                 content.
+  corruption     every header carries a sha256 of the blob; any
+                 unreadable/truncated/mismatched entry is evicted and
+                 the caller falls back to a fresh compile (and rewrites
+                 the entry).
+  bounded size   a size-capped LRU keeps the directory under
+                 `max_bytes`: loads touch the file's mtime, writes evict
+                 oldest-read entries until the total fits.
+
+The store is shared by `TrainStepCache` and `InferCache` (one key
+schema, one export format); see `MultiLayerNetwork.set_compile_cache`
+and the CLI's `--compile-cache DIR` / `warmup` subcommand for the
+user-facing wiring.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import logging
+import os
+import struct
+import tempfile
+import time
+from typing import Optional, Tuple
+
+log = logging.getLogger("deeplearning4j_tpu")
+
+#: bump to invalidate every existing artifact on a format change
+FORMAT_VERSION = 1
+
+_MAGIC = b"DL4JJXP1"
+_SUFFIX = ".jxp"
+
+#: default directory cap; override per-store or via env
+DEFAULT_MAX_BYTES = int(os.environ.get("DL4J_COMPILE_CACHE_MAX_BYTES",
+                                       str(1 << 30)))
+
+
+def platform_info() -> dict:
+    """The platform facts an XLA executable is only valid for: backend,
+    device kind, visible-device topology, and the jax/jaxlib pair that
+    produced the StableHLO.  Kept as a dict (stored in every header) so
+    a mismatch is diagnosable, fingerprinted for the fast path."""
+    import jax
+    import jaxlib
+
+    devs = jax.devices()
+    return {
+        "format": FORMAT_VERSION,
+        "backend": jax.default_backend(),
+        "device_kind": devs[0].device_kind if devs else "none",
+        "n_devices": len(devs),
+        "jax": jax.__version__,
+        "jaxlib": jaxlib.__version__,
+    }
+
+
+def platform_fingerprint(info: Optional[dict] = None) -> str:
+    """Stable fingerprint of `platform_info` (sha1 of canonical JSON)."""
+    info = platform_info() if info is None else info
+    blob = json.dumps(info, sort_keys=True).encode("utf-8")
+    return hashlib.sha1(blob).hexdigest()[:16]
+
+
+def canonical_key(key: Tuple) -> str:
+    """Deterministic string form of a cache key (tuples of str/int/None
+    nest arbitrarily; repr is stable for those)."""
+    return repr(key)
+
+
+class PersistentProgramStore:
+    """Versioned on-disk store for `jax.export`-serialized programs.
+
+    load/store never raise on entry-level problems — a bad entry is
+    evicted and reported via the counters, and the caller recompiles.
+    Directory-level problems (unwritable path) raise at construction.
+    """
+
+    def __init__(self, directory: str,
+                 max_bytes: int = DEFAULT_MAX_BYTES):
+        self.directory = os.path.abspath(os.path.expanduser(directory))
+        self.max_bytes = int(max_bytes)
+        os.makedirs(self.directory, exist_ok=True)
+        self._platform = platform_info()
+        self._fingerprint = platform_fingerprint(self._platform)
+        # entry-level health counters (the per-cache timing/hit split
+        # lives on StepCacheStats — stores can be shared across caches)
+        self.writes = 0
+        self.evictions = 0
+        self.corrupt_evicted = 0
+
+    # -- paths --------------------------------------------------------------
+    def path_for(self, key: Tuple) -> str:
+        name = hashlib.sha256(
+            (self._fingerprint + "|" + canonical_key(key))
+            .encode("utf-8")).hexdigest()[:40]
+        return os.path.join(self.directory, name + _SUFFIX)
+
+    # -- load ---------------------------------------------------------------
+    def load(self, key: Tuple):
+        """Deserialized `jax.export.Exported` for `key`, or None.
+
+        None covers every miss flavor: absent file, foreign platform,
+        format bump, checksum mismatch, undeserializable blob — the last
+        three also evict the entry so the rewrite is clean."""
+        path = self.path_for(key)
+        try:
+            with open(path, "rb") as f:
+                raw = f.read()
+        except (FileNotFoundError, IsADirectoryError):
+            return None
+        except OSError as e:
+            log.warning("compile-cache: unreadable %s (%s)", path, e)
+            return None
+        try:
+            if raw[:8] != _MAGIC:
+                raise ValueError("bad magic")
+            (hlen,) = struct.unpack(">I", raw[8:12])
+            header = json.loads(raw[12:12 + hlen].decode("utf-8"))
+            blob = raw[12 + hlen:]
+            if header.get("platform_fingerprint") != self._fingerprint:
+                # foreign artifact (filename hash should prevent this;
+                # header check is defense in depth) — never load it
+                raise ValueError("platform fingerprint mismatch")
+            if header.get("key") != canonical_key(key):
+                raise ValueError("key collision/mismatch")
+            if (header.get("blob_sha256")
+                    != hashlib.sha256(blob).hexdigest()):
+                raise ValueError("blob checksum mismatch")
+            from jax import export as jax_export
+
+            exported = jax_export.deserialize(bytearray(blob))
+        except Exception as e:  # noqa: BLE001 — any bad entry: evict
+            self.corrupt_evicted += 1
+            log.warning("compile-cache: evicting bad entry %s (%s)",
+                        os.path.basename(path), e)
+            self._remove(path)
+            return None
+        # LRU touch: loads refresh recency so hot serve-path entries
+        # outlive cold ones under the size cap
+        try:
+            os.utime(path, None)
+        except OSError:
+            pass
+        return exported
+
+    # -- store --------------------------------------------------------------
+    def store(self, key: Tuple, exported) -> bool:
+        """Atomically persist an `Exported` under `key`; returns success.
+
+        tmpfile + `os.replace` in the same directory: readers never see
+        a torn entry, concurrent writers of the same key converge on one
+        winner with identical content."""
+        path = self.path_for(key)
+        try:
+            blob = bytes(exported.serialize())
+            header = json.dumps({
+                "format": FORMAT_VERSION,
+                "platform_fingerprint": self._fingerprint,
+                "platform": self._platform,
+                "key": canonical_key(key),
+                "created": time.time(),
+                "blob_sha256": hashlib.sha256(blob).hexdigest(),
+            }, sort_keys=True).encode("utf-8")
+            payload = _MAGIC + struct.pack(">I", len(header)) + header + blob
+            fd, tmp = tempfile.mkstemp(dir=self.directory,
+                                       suffix=_SUFFIX + ".tmp")
+            try:
+                with os.fdopen(fd, "wb") as f:
+                    f.write(payload)
+                    f.flush()
+                    os.fsync(f.fileno())
+                os.replace(tmp, path)
+            except BaseException:
+                self._remove(tmp)
+                raise
+        except Exception as e:  # noqa: BLE001 — persistence is best-effort
+            log.warning("compile-cache: failed to persist %s (%s)", key, e)
+            return False
+        self.writes += 1
+        self._enforce_cap(keep=path)
+        return True
+
+    def evict(self, key: Tuple) -> None:
+        self._remove(self.path_for(key))
+
+    # -- size cap -----------------------------------------------------------
+    def _entries(self):
+        """[(path, size, mtime)] for every cache entry currently on disk."""
+        out = []
+        try:
+            names = os.listdir(self.directory)
+        except OSError:
+            return out
+        for name in names:
+            if not name.endswith(_SUFFIX):
+                continue
+            p = os.path.join(self.directory, name)
+            try:
+                st = os.stat(p)
+            except OSError:
+                continue
+            out.append((p, st.st_size, st.st_mtime))
+        return out
+
+    def total_bytes(self) -> int:
+        return sum(size for _, size, _ in self._entries())
+
+    def _enforce_cap(self, keep: Optional[str] = None) -> None:
+        """Evict least-recently-used entries until the directory fits
+        `max_bytes`.  The just-written entry (`keep`) is preferred even
+        if it alone exceeds the cap — an empty cache is strictly worse."""
+        entries = self._entries()
+        total = sum(size for _, size, _ in entries)
+        if total <= self.max_bytes:
+            return
+        for p, size, _ in sorted(entries, key=lambda e: e[2]):
+            if total <= self.max_bytes:
+                break
+            if p == keep:
+                continue
+            self._remove(p)
+            self.evictions += 1
+            total -= size
+            log.info("compile-cache: LRU-evicted %s (%d bytes)",
+                     os.path.basename(p), size)
+
+    @staticmethod
+    def _remove(path: str) -> None:
+        try:
+            os.remove(path)
+        except OSError:
+            pass
+
+    def __len__(self):
+        return len(self._entries())
+
+    def __repr__(self):
+        return (f"PersistentProgramStore({self.directory!r}, "
+                f"entries={len(self)}, bytes={self.total_bytes()}, "
+                f"platform={self._fingerprint})")

@@ -79,13 +79,23 @@ def arg_signature(*arrays) -> Tuple:
 
 
 class StepCacheStats:
-    """Counters exposed on the cache object (ISSUE: observability)."""
+    """Counters exposed on the cache object (ISSUE: observability).
+
+    The memory-vs-disk-vs-compile split: `hits` are in-memory program
+    reuses, `disk_hits` are programs restored from the persistent store
+    (trace/lower skipped, deserialize+compile paid — see
+    `deserialize_seconds`), `misses` are fresh trace+compiles
+    (`compile_seconds`); `disk_write_seconds` is the write-back cost of
+    persisting fresh compiles."""
 
     def __init__(self):
         self.hits = 0
         self.misses = 0
         self.steps = 0                      # compiled-step executions
         self.compile_seconds: Dict[Tuple, float] = {}  # key -> seconds
+        self.disk_hits = 0
+        self.disk_write_seconds = 0.0
+        self.deserialize_seconds = 0.0
 
     @property
     def total_compile_seconds(self) -> float:
@@ -94,7 +104,10 @@ class StepCacheStats:
     def as_dict(self) -> dict:
         return {"hits": self.hits, "misses": self.misses,
                 "steps": self.steps, "entries": len(self.compile_seconds),
-                "compile_seconds": round(self.total_compile_seconds, 3)}
+                "compile_seconds": round(self.total_compile_seconds, 3),
+                "disk_hits": self.disk_hits,
+                "disk_write_seconds": round(self.disk_write_seconds, 3),
+                "deserialize_seconds": round(self.deserialize_seconds, 3)}
 
     def __repr__(self):
         return f"StepCacheStats({self.as_dict()})"
@@ -114,19 +127,35 @@ class CompiledProgramCache:
     buckets: optional fixed iterable of allowed batch-row buckets; by
     default buckets grow on demand from the batch sizes seen (full
     batches come first in practice, tails then pad up into them).
+    persist: optional `optimize.persist.PersistentProgramStore` — memory
+    misses check the on-disk store before compiling (disk hit: the
+    trace/lower cost is skipped, `stats.disk_hits`/`deserialize_seconds`
+    grow), and fresh compiles write back (`stats.disk_write_seconds`).
     """
 
     #: label used in miss logs so train/infer retraces are distinguishable
     kind = "program-cache"
 
     def __init__(self, donate: Optional[bool] = None,
-                 buckets: Optional[Tuple[int, ...]] = None):
+                 buckets: Optional[Tuple[int, ...]] = None,
+                 persist=None):
         self._programs: Dict[Tuple, Callable] = {}
         self._fingerprints: Dict[int, str] = {}  # id(conf) memo
         self._buckets: List[int] = sorted(buckets) if buckets else []
         self._fixed_buckets = buckets is not None
         self._donate = donate
+        self._persist = persist
         self.stats = StepCacheStats()
+
+    # -- persistence --------------------------------------------------------
+    @property
+    def persist(self):
+        return self._persist
+
+    def set_persist(self, store) -> None:
+        """Attach (or detach with None) a `PersistentProgramStore` —
+        already-compiled in-memory programs stay valid either way."""
+        self._persist = store
 
     # -- bucket policy ------------------------------------------------------
     def bucket_rows(self, n: int) -> int:
@@ -164,25 +193,115 @@ class CompiledProgramCache:
         return (0,) if donate else ()
 
     def _get(self, key: Tuple, build: Callable[[], Callable], args: Tuple):
-        """Return the compiled executable for `key`, compiling (and
-        timing) it via AOT lower+compile on a miss."""
+        """Return the compiled executable for `key`: memory hit, else
+        disk hit (persistent store attached), else a timed fresh
+        trace+compile with disk write-back."""
         fn = self._programs.get(key)
         if fn is not None:
             self.stats.hits += 1
             return fn
-        self.stats.misses += 1
-        t0 = time.perf_counter()
-        jitted = jax.jit(build(), donate_argnums=self._donate_argnums())
         abstract = jax.tree_util.tree_map(
             lambda a: jax.ShapeDtypeStruct(jnp.shape(a),
                                            jnp.asarray(a).dtype), args)
-        fn = jitted.lower(*abstract).compile()
+        donate = self._donate_argnums()
+        if self._persist is not None:
+            fn = self._load_from_disk(key, abstract, donate)
+            if fn is not None:
+                return fn
+        self.stats.misses += 1
+        t0 = time.perf_counter()
+        exported = None
+        if self._persist is not None:
+            # fresh compiles ALSO route through jax.export: the executed
+            # module is the exact module a later disk hit restores, so
+            # cold and warm-disk runs match bit-for-bit — and the trace
+            # happens once (export), never again for this artifact
+            try:
+                from jax import export as jax_export
+
+                exported = jax_export.export(jax.jit(build()))(*abstract)
+                fn = jax.jit(exported.call,
+                             donate_argnums=donate).lower(*abstract).compile()
+            except Exception as e:  # noqa: BLE001 — non-exportable program
+                log.warning("%s: program %s is not exportable (%s); "
+                            "compiling without persistence", self.kind, key, e)
+                exported, fn = None, None
+        if exported is None:
+            jitted = jax.jit(build(), donate_argnums=donate)
+            fn = jitted.lower(*abstract).compile()
         dt = time.perf_counter() - t0
         self.stats.compile_seconds[key] = dt
         log.info("%s miss: compiled %s in %.2fs (entry %d)",
                  self.kind, key, dt, len(self._programs) + 1)
+        if exported is not None:
+            tw = time.perf_counter()
+            self._persist.store(key, exported)
+            self.stats.disk_write_seconds += time.perf_counter() - tw
         self._programs[key] = fn
         return fn
+
+    def _load_from_disk(self, key: Tuple, abstract, donate):
+        """Disk half of `_get`: deserialize + AOT-compile a persisted
+        program.  Any failure (corrupt entry already evicted by the
+        store, platform drift the fingerprint missed) returns None and
+        the caller recompiles."""
+        t0 = time.perf_counter()
+        exported = self._persist.load(key)
+        if exported is None:
+            return None
+        try:
+            fn = jax.jit(exported.call,
+                         donate_argnums=donate).lower(*abstract).compile()
+        except Exception as e:  # noqa: BLE001 — treat as corrupt: evict
+            log.warning("%s: persisted entry for %s failed to compile "
+                        "(%s); evicting and recompiling", self.kind, key, e)
+            self._persist.evict(key)
+            return None
+        dt = time.perf_counter() - t0
+        self.stats.disk_hits += 1
+        self.stats.deserialize_seconds += dt
+        log.info("%s disk hit: restored %s in %.2fs (entry %d)",
+                 self.kind, key, dt, len(self._programs) + 1)
+        self._programs[key] = fn
+        return fn
+
+    def track_jit(self, base_key: Tuple, jitted) -> Callable:
+        """Wrap an already-jitted program (e.g. a shard_map'd dp train
+        step) so its per-shape AOT compiles are timed and counted in
+        this cache's stats like every single-chip program.  lower() runs
+        on the REAL args of the triggering call, so GSPMD/mesh shardings
+        are preserved; entries are keyed by `base_key` + the flattened
+        arg signature + the arg SHARDINGS — a compiled executable only
+        accepts the exact layouts it was built for, and dp params really
+        do change layout once (host-resident at step 0, mesh-replicated
+        after), which is a genuine second program, not a re-trace.  No
+        disk persistence (multi-device layouts are process-topology-
+        bound; the platform fingerprint would thrash)."""
+
+        def wrapped(*args):
+            leaves = jax.tree_util.tree_leaves(args)
+            shards = tuple(str(getattr(l, "sharding", None))
+                           for l in leaves)
+            key = tuple(base_key) + (arg_signature(*leaves), shards)
+            fn = self._programs.get(key)
+            if fn is None:
+                self.stats.misses += 1
+                t0 = time.perf_counter()
+                fn = jitted.lower(*args).compile()
+                dt = time.perf_counter() - t0
+                self.stats.compile_seconds[key] = dt
+                log.info("%s miss: compiled %s in %.2fs (entry %d)",
+                         self.kind, key, dt, len(self._programs) + 1)
+                self._programs[key] = fn
+            else:
+                self.stats.hits += 1
+            self.stats.steps += 1
+            return fn(*args)
+
+        # callers that AOT-compile explicitly (bench MFU) reach through
+        wrapped.lower = jitted.lower
+        wrapped.__wrapped__ = jitted
+        return wrapped
 
     def clear(self) -> None:
         self._programs.clear()
@@ -218,7 +337,7 @@ class TrainStepCache(CompiledProgramCache):
     kind = "step-cache"
 
     # -- network train steps ------------------------------------------------
-    def finetune(self, conf, params, x, y, key):
+    def finetune(self, conf, params, x, y, key, compile_only: bool = False):
         """One cached supervised solver run (`MultiLayerNetwork.finetune`
         body): pads (x, y) to the bucket, fetches/compiles the program
         for (conf, algo, shapes) and executes it.
@@ -226,7 +345,11 @@ class TrainStepCache(CompiledProgramCache):
         Returns (new_params, per-iteration scores).  BatchNorm running
         stats are advanced INSIDE the program from the last solver
         iteration's batch moments (`update_bn_ema_from_stats`) — no
-        second forward pass."""
+        second forward pass.
+
+        compile_only=True (warmup) registers the bucket and compiles —
+        or disk-restores — the program without executing a step; params
+        are untouched and None is returned."""
         from deeplearning4j_tpu.nn.multilayer import has_batchnorm
 
         out_conf = conf.conf(conf.n_layers - 1)
@@ -239,6 +362,8 @@ class TrainStepCache(CompiledProgramCache):
         args = (params, x, y, w, key)
         fn = self._get(cache_key,
                        lambda: _finetune_program(conf, collect_bn), args)
+        if compile_only:
+            return None
         self.stats.steps += 1
         return fn(*args)
 

@@ -35,7 +35,7 @@ from deeplearning4j_tpu.nn.multilayer import (MultiLayerNetwork, has_batchnorm,
 from deeplearning4j_tpu.optimize.updater import (UpdaterState, adjust_gradient,
                                                  init_updater)
 from deeplearning4j_tpu.parallel.mesh import shard_batch
-from deeplearning4j_tpu.parallel.sequence import _as_varying
+from deeplearning4j_tpu.parallel.sequence import _as_varying, _shard_map
 
 import logging
 
@@ -74,7 +74,7 @@ def _feature_row_weights(w, x):
 
 def make_dp_train_step(conf: MultiLayerConfiguration, mesh: Mesh,
                        axis: str = "dp", masked: bool = False,
-                       grad_accum: int = 1):
+                       grad_accum: int = 1, cache=None):
     """Compile one data-parallel training step.
 
     Unmasked (default): `step(state, x, y, key) -> (state, mean_score)`,
@@ -89,6 +89,11 @@ def make_dp_train_step(conf: MultiLayerConfiguration, mesh: Mesh,
     psum(sum(w)) + regularization; gradients via psum of per-shard
     contributions (exact global weighted mean).  BATCH_NORM statistics are
     weighted the same way (pad rows don't skew the normalization).
+
+    cache: optional `optimize.step_cache.CompiledProgramCache` — the
+    step's per-shape AOT compiles are then timed/counted in its stats
+    (`track_jit`), so multi-chip compiles are as observable as the
+    single-chip train/infer caches.
 
     grad_accum=k splits each shard's batch into k microbatches, runs the
     forward/backward per microbatch under `lax.scan` (peak activation
@@ -197,17 +202,21 @@ def make_dp_train_step(conf: MultiLayerConfiguration, mesh: Mesh,
         def fn(state, x, y, key):
             return local_step(state, x, y, None, key)
         in_specs = (rep, P(axis), P(axis), rep)
-    sharded = jax.shard_map(fn, mesh=mesh, in_specs=in_specs,
-                            out_specs=(rep, rep))
-    return jax.jit(sharded, donate_argnums=(0,))
+    sharded = _shard_map(fn, mesh, in_specs, (rep, rep))
+    jitted = jax.jit(sharded, donate_argnums=(0,))
+    if cache is not None:
+        return cache.track_jit(
+            ("dp_step", axis, masked, grad_accum), jitted)
+    return jitted
 
 
 def make_masked_dp_train_step(conf: MultiLayerConfiguration, mesh: Mesh,
-                              axis: str = "dp"):
-    return make_dp_train_step(conf, mesh, axis, masked=True)
+                              axis: str = "dp", cache=None):
+    return make_dp_train_step(conf, mesh, axis, masked=True, cache=cache)
 
 
-def make_sharded_train_step(conf: MultiLayerConfiguration, mesh: Mesh):
+def make_sharded_train_step(conf: MultiLayerConfiguration, mesh: Mesh,
+                            cache=None):
     """Compiler-partitioned (pjit-style) training step for meshes with
     tensor-parallel axes: params get `tp` shardings via `param_pspecs`,
     batch is sharded over `dp`, and XLA inserts the collectives (psum for
@@ -233,7 +242,10 @@ def make_sharded_train_step(conf: MultiLayerConfiguration, mesh: Mesh):
             params = update_bn_ema_from_stats(conf, params, stats)
         return TrainState(params, upd, state.step + 1), score
 
-    return jax.jit(step_fn, donate_argnums=(0,))
+    jitted = jax.jit(step_fn, donate_argnums=(0,))
+    if cache is not None:
+        return cache.track_jit(("sharded_step",), jitted)
+    return jitted
 
 
 def zero1_pspecs(tree, mesh: Mesh, axis: str = "dp"):
@@ -362,7 +374,7 @@ def shard_train_state(state: TrainState, mesh: Mesh, tp_axis: str = "tp"):
 
 def make_averaging_round(conf: MultiLayerConfiguration, mesh: Mesh,
                          local_steps: int, axis: str = "dp",
-                         masked: bool = False):
+                         masked: bool = False, cache=None):
     """Compile one BSP IterativeReduce round: every dp shard takes
     `local_steps` independent updater-chain steps on its own data, then
     parameters are averaged (`pmean`) — exact reference semantics
@@ -450,14 +462,19 @@ def make_averaging_round(conf: MultiLayerConfiguration, mesh: Mesh,
         def fn(state, x, y, key):
             return round_fn(state, x, y, None, key)
         in_specs = (rep, P(axis), P(axis), rep)
-    sharded = jax.shard_map(fn, mesh=mesh, in_specs=in_specs,
-                            out_specs=(rep, rep))
-    return jax.jit(sharded, donate_argnums=(0,))
+    sharded = _shard_map(fn, mesh, in_specs, (rep, rep))
+    jitted = jax.jit(sharded, donate_argnums=(0,))
+    if cache is not None:
+        return cache.track_jit(
+            ("dp_averaging", axis, masked, local_steps), jitted)
+    return jitted
 
 
 def make_masked_averaging_round(conf: MultiLayerConfiguration, mesh: Mesh,
-                                local_steps: int, axis: str = "dp"):
-    return make_averaging_round(conf, mesh, local_steps, axis, masked=True)
+                                local_steps: int, axis: str = "dp",
+                                cache=None):
+    return make_averaging_round(conf, mesh, local_steps, axis, masked=True,
+                                cache=cache)
 
 
 class DataParallelTrainer:
@@ -479,15 +496,23 @@ class DataParallelTrainer:
         self.listeners = list(listeners)
         if net.params is None:
             net.init()
+        # multi-chip compile observability: every step variant's AOT
+        # compile is timed/counted here, like the single-chip caches
+        from deeplearning4j_tpu.optimize.step_cache import (
+            CompiledProgramCache)
+
+        self.compile_cache = CompiledProgramCache()
+        self.compile_cache.kind = "dp-step-cache"
         if mode == "sync":
             self._step = make_dp_train_step(net.conf, mesh, axis,
-                                            grad_accum=grad_accum)
+                                            grad_accum=grad_accum,
+                                            cache=self.compile_cache)
         elif mode == "averaging":
             if grad_accum > 1:
                 raise ValueError(
                     "grad_accum is only supported in mode='sync'")
             self._step = make_averaging_round(net.conf, mesh, local_steps,
-                                              axis)
+                                              axis, cache=self.compile_cache)
         else:
             raise ValueError(f"unknown mode {mode!r}")
         self._local_steps = local_steps
@@ -533,10 +558,12 @@ class DataParallelTrainer:
                     "grad_accum=%d (single fwd/bwd)", b, self._grad_accum)
             if self.mode == "sync":
                 self._masked_step = make_masked_dp_train_step(
-                    self.net.conf, self.mesh, self.axis)
+                    self.net.conf, self.mesh, self.axis,
+                    cache=self.compile_cache)
             else:
                 self._masked_step = make_masked_averaging_round(
-                    self.net.conf, self.mesh, self._local_steps, self.axis)
+                    self.net.conf, self.mesh, self._local_steps, self.axis,
+                    cache=self.compile_cache)
         x = jnp.concatenate(
             [x, jnp.zeros((pad,) + x.shape[1:], x.dtype)])
         y = jnp.concatenate(
@@ -573,5 +600,12 @@ class DataParallelTrainer:
                     for li in self.listeners:
                         li.iteration_done(self, int(self.state.step),
                                           float(s))
-        self.net.params = self.state.params
+        # hand the net a single-device copy: the serve/train-path AOT
+        # programs compile for single-chip layouts, and an
+        # already-compiled executable can't reshard a mesh-replicated
+        # NamedSharding leaf the way plain jit would.  Replicated params
+        # make this a local device copy (async, no host roundtrip).
+        self.net.params = jax.tree_util.tree_map(
+            lambda a: jax.device_put(a, self.mesh.devices.flat[0]),
+            self.state.params)
         return float(score) if score is not None else float("nan")

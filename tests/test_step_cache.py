@@ -179,6 +179,47 @@ def test_padded_tail_batchnorm_stats_match_unpadded():
     np.testing.assert_allclose(mean, np.asarray(x).mean(0), atol=0.2)
 
 
+def test_conv_batchnorm_padded_tail_bitforbit():
+    """Conv/NCHW nets keep the padded-remainder guarantee: an 11-row tail
+    padded into a 16-bucket trains a conv+BatchNorm+pool stack to the SAME
+    float32 params as the unpadded run (the 4-D BN moment/affine path is
+    gemm-contracted like the 2-D one — see layers/base.py)."""
+    from deeplearning4j_tpu.models.zoo import _base
+    from deeplearning4j_tpu.nn.conf import (Activation, LossFunction,
+                                            PoolingType)
+
+    b = _base(lr=0.05, iters=2)
+    confs = (
+        b.replace(layer_type=LayerType.CONVOLUTION, n_channels=1, n_out=4,
+                  kernel_size=(3, 3), stride=(1, 1)),
+        b.replace(layer_type=LayerType.BATCH_NORM, n_in=4, n_out=4),
+        b.replace(layer_type=LayerType.SUBSAMPLING, kernel_size=(2, 2),
+                  stride=(2, 2), pooling=PoolingType.MAX),
+        b.replace(layer_type=LayerType.OUTPUT, n_in=4 * 3 * 3, n_out=3,
+                  activation=Activation.SOFTMAX,
+                  loss_function=LossFunction.MCXENT),
+    )
+    conf = MultiLayerConfiguration(
+        confs=confs, pretrain=False, backprop=True,
+        input_preprocessors=((0, "ff_to_conv:1:8:8"), (3, "conv_to_ff")))
+    params0 = MultiLayerNetwork(conf, seed=3).init().params
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(11, 64).astype(np.float32))
+    y = jnp.asarray(np.eye(3, dtype=np.float32)[rng.randint(0, 3, 11)])
+
+    padded = TrainStepCache()
+    padded.bucket_rows(16)  # pre-register: the 11-row tail pads into it
+    p_pad, s_pad = padded.finetune(conf, params0, x, y, KEY)
+    p_ref, s_ref = TrainStepCache().finetune(conf, params0, x, y, KEY)
+
+    np.testing.assert_array_equal(np.asarray(s_pad), np.asarray(s_ref))
+    for lc, lr in zip(p_pad, p_ref):
+        for name in lc:
+            np.testing.assert_array_equal(np.asarray(lc[name]),
+                                          np.asarray(lr[name]),
+                                          err_msg=name)
+
+
 def test_bn_fit_skips_second_forward_ema_pass():
     """fit() on a BN net through the cache advances the EMA inside the
     compiled step (no legacy `update_bn_ema` recompute) and still lands
