@@ -20,10 +20,13 @@ back and burned the whole budget in claim churn; 0/8 benches two rounds
 running).  The axon TPU tunnel claim can pend for many minutes under pool
 contention, and the driver kills the whole suite at ~1500s.  Design:
 
-  - ONE child; the parent NEVER kills it while its device claim is
-    pending (measuring is impossible without a device, so killing a
-    pending claim can only lose queue position) — only the global
-    deadline ends a claim wait;
+  - ONE child; its device claim gets `claim_cap_s` (a third of the
+    budget, bounded by what the global deadline leaves).  The child's
+    own retry loop falls back to tagged CPU when init FAILS within the
+    cap; a claim WEDGED inside jax.devices() (BENCH_r05: heartbeat to
+    1350s, 0/8 benches — the retry deadline only runs between attempts)
+    is killed by the parent's claim-phase watchdog and relaunched with
+    the CPU fallback forced, so the cap fires either way;
   - the child prints a claim-progress heartbeat to stderr every 30s, so
     even a failed artifact shows how long the claim was pending;
   - the parent STREAMS the child's stdout line-by-line, so metrics
@@ -56,6 +59,13 @@ import numpy as np
 _CHILD_ENV = "DL4J_BENCH_CHILD"
 _SKIP_ENV = "DL4J_BENCH_SKIP"
 _DEADLINE_ENV = "DL4J_BENCH_DEADLINE"
+# set by the parent after a child's device claim outlived the claim cap:
+# the relaunched child skips the claim entirely and runs tagged on CPU
+_FORCE_CPU_ENV = "DL4J_BENCH_FORCE_CPU"
+# test hook: simulate a tunnel claim that BLOCKS inside jax.devices() for
+# this many seconds (the BENCH_r05 failure mode — the retry loop's own
+# deadline only runs BETWEEN attempts, so it cannot interrupt this)
+_FAKE_CLAIM_HANG_ENV = "DL4J_BENCH_FAKE_CLAIM_HANG_S"
 GLOBAL_BUDGET_S = int(os.environ.get("DL4J_BENCH_TOTAL_S", "1380"))
 # post-claim run cap per attempt; defaults to the whole global budget so
 # in production only the global deadline ever kills the child (the knob
@@ -69,6 +79,12 @@ PER_BENCH_BUDGET_S = int(os.environ.get("DL4J_BENCH_PER_BENCH_S", "300"))
 # whole budget pending (BENCH_r05: 0/8 benches ran, all claim churn)
 CLAIM_BUDGET_S = int(os.environ.get("DL4J_BENCH_CLAIM_S",
                                     str(GLOBAL_BUDGET_S // 3)))
+# parent-side grace on top of the child's own claim cap: the child's
+# in-process fallback (which preserves queue position) gets first shot;
+# only a child WEDGED inside backend init (its retry loop checks the
+# deadline between attempts, so a blocking jax.devices() never trips it —
+# the BENCH_r05 0/8 failure) is killed and relaunched with _FORCE_CPU_ENV
+CLAIM_KILL_GRACE_S = int(os.environ.get("DL4J_BENCH_CLAIM_GRACE_S", "30"))
 MAX_ATTEMPTS = 3
 RETRY_PAUSE_S = 5
 # smoke-test mode: tiny shapes/steps so the suite runs in seconds on CPU
@@ -90,13 +106,35 @@ def _emit(metric: str, value: float, unit: str, vs_baseline, **extra) -> None:
     print(json.dumps(line), flush=True)
 
 
+def claim_cap_s(remaining_s: float,
+                claim_budget_s: float | None = None) -> float:
+    """Seconds a device claim may pend before the CPU fallback fires:
+    the claim budget (GLOBAL_BUDGET_S/3 by default), never more than
+    what the remaining global budget leaves after a 60s run reserve,
+    and never less than a 60s floor (a sub-minute claim window would
+    fail even an uncontended tunnel claim)."""
+    if claim_budget_s is None:
+        claim_budget_s = CLAIM_BUDGET_S
+    return min(float(claim_budget_s), max(60.0, remaining_s - 60.0))
+
+
 def _devices_with_retry(max_wait: float = 600.0):
     """jax.devices() with bounded retry/backoff.
 
     Backend-init failures (tunnel claim contention -> UNAVAILABLE) are
-    cached by jax, so each retry clears the failed backend first."""
+    cached by jax, so each retry clears the failed backend first.
+    NOTE: the deadline is only checked BETWEEN attempts — a jax.devices()
+    call that blocks indefinitely inside backend init is out of this
+    function's reach; the PARENT's claim-phase watchdog
+    (`_stream_attempt`) covers that mode by killing the child and
+    relaunching it with the CPU fallback forced."""
     import jax
 
+    hang = float(os.environ.get(_FAKE_CLAIM_HANG_ENV, "0") or 0.0)
+    if hang:  # test hook: a claim wedged inside jax.devices()
+        print(f"bench: FAKE claim hang {hang:.0f}s", file=sys.stderr,
+              flush=True)
+        time.sleep(hang)
     platform = os.environ.get("DL4J_BENCH_PLATFORM")
     if platform:  # test hook: JAX_PLATFORMS env alone does not stop the
         jax.config.update("jax_platforms", platform)  # axon plugin here
@@ -593,6 +631,85 @@ def bench_infer_latency(devs) -> None:
 
 
 # ---------------------------------------------------------------------------
+# serve — closed-loop concurrent clients through the micro-batching gateway
+# ---------------------------------------------------------------------------
+
+def bench_serve(devs) -> None:
+    """Closed-loop concurrent clients against the micro-batching gateway
+    (serving/batcher.py): each client loops `predict(1 row)` and issues
+    the next request only after the previous answer lands.  Batching ON
+    coalesces the fleet into one bucketed infer-cache call per flush;
+    batching OFF is the same fleet calling `net.output` directly (one
+    device program dispatch per request — the pre-gateway serving path).
+    Headline = the batched/unbatched rows/s multiple; p99 per-request
+    latency goes out for both arms."""
+    from deeplearning4j_tpu.models.zoo import mlp
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.serving import MicroBatcher
+
+    clients, secs, hidden = (8, 1.0, [64]) if SMALL else (32, 6.0, [512, 512])
+    conf = mlp(784, hidden, 10)
+    net = MultiLayerNetwork(conf, seed=0).init()
+    rng = np.random.RandomState(0)
+    xs = [rng.rand(1, 784).astype(np.float32) for _ in range(clients)]
+    # warm the coalesced bucket AND the single-row bucket so neither arm
+    # pays a compile inside its timed window
+    net.warmup([clients, 1])
+
+    def closed_loop(predict_fn):
+        lat = [[] for _ in range(clients)]
+        rows = [0] * clients
+        start_evt = threading.Event()
+        stop_t = [0.0]
+
+        def client(i):
+            start_evt.wait()
+            while time.perf_counter() < stop_t[0]:
+                t0 = time.perf_counter()
+                predict_fn(xs[i])
+                lat[i].append(time.perf_counter() - t0)
+                rows[i] += 1
+
+        threads = [threading.Thread(target=client, args=(i,))
+                   for i in range(clients)]
+        for t in threads:
+            t.start()
+        t_begin = time.perf_counter()
+        stop_t[0] = t_begin + secs
+        start_evt.set()
+        for t in threads:
+            t.join()
+        dt = time.perf_counter() - t_begin
+        all_lat = sorted(v for per in lat for v in per)
+        p99 = all_lat[min(len(all_lat) - 1,
+                          int(0.99 * (len(all_lat) - 1)))] if all_lat else 0.0
+        return sum(rows) / dt, p99 * 1e3
+
+    # batching OFF first (its numbers are the baseline of the headline)
+    off_rows_s, off_p99_ms = closed_loop(
+        lambda x: np.asarray(net.output(x)))
+
+    misses_before = net.infer_cache.stats.misses  # warmup's prepaid compiles
+    batcher = MicroBatcher(net, max_delay_ms=2.0).start()
+    on_rows_s, on_p99_ms = closed_loop(
+        lambda x: batcher.predict(x, timeout=60.0))
+    st = batcher.stats()
+    batcher.stop()
+
+    multiple = on_rows_s / max(off_rows_s, 1e-9)
+    _emit("serve gateway batched rows/sec", on_rows_s, "rows/sec", multiple,
+          clients=clients,
+          rows_per_sec_unbatched=round(off_rows_s, 1),
+          p99_ms_batched=round(on_p99_ms, 2),
+          p99_ms_unbatched=round(off_p99_ms, 2),
+          mean_batch_rows=round(st["rows"] / max(
+              sum(st["batch_rows_hist"].values()), 1), 2),
+          fresh_compiles_during_serving=st["fresh_compiles"] - misses_before,
+          baseline_note=f"vs_baseline = rows/s multiple vs batching OFF, "
+                        f"same {clients} closed-loop clients")
+
+
+# ---------------------------------------------------------------------------
 # prefetch — LeNet mini-batch fit with the async device_put pipeline on/off
 # ---------------------------------------------------------------------------
 
@@ -783,53 +900,66 @@ def bench_cold_start(devs) -> None:
 BENCHES = [bench_lenet, bench_char_lstm, bench_vgg_cifar10, bench_word2vec,
            bench_dp_allreduce,
            bench_char_lstm4, bench_step_cache, bench_infer_latency,
-           bench_prefetch, bench_cold_start, bench_north_star_cli,
-           bench_transformer_mfu]
+           bench_serve, bench_prefetch, bench_cold_start,
+           bench_north_star_cli, bench_transformer_mfu]
 BASELINE_FIVE = {"bench_lenet", "bench_char_lstm", "bench_vgg_cifar10",
                  "bench_word2vec", "bench_dp_allreduce"}
 
 
 def run_child() -> int:
+    global _BACKEND_TAG
     skip = set(filter(None, os.environ.get(_SKIP_ENV, "").split(",")))
     global_deadline = float(os.environ.get(_DEADLINE_ENV, "0")) or (
         time.time() + 86400.0)
 
-    # claim-progress heartbeat: even if the claim pends until the driver
-    # kills us, the stderr tail shows exactly how long it was pending
     claim_t0 = time.time()
-    claimed_evt = threading.Event()
-
-    def _claim_heartbeat():
-        while not claimed_evt.wait(30.0):
-            print(f"bench: device claim pending {time.time() - claim_t0:.0f}s",
-                  file=sys.stderr, flush=True)
-
-    threading.Thread(target=_claim_heartbeat, daemon=True).start()
-    # the claim gets at most CLAIM_BUDGET_S (and never more than what the
-    # global deadline leaves): past that, a CPU run with a tagged backend
-    # beats an empty perf trajectory
-    claim_cap = min(float(CLAIM_BUDGET_S),
-                    max(60.0, global_deadline - time.time() - 60.0))
-    try:
-        devs = _devices_with_retry(max_wait=claim_cap)
-    except Exception as e:  # noqa: BLE001 — claim stalled: CPU fallback
-        global _BACKEND_TAG
+    if os.environ.get(_FORCE_CPU_ENV) == "1":
+        # a previous attempt's claim was wedged inside backend init until
+        # the parent's watchdog killed it: skip the claim entirely and
+        # run the suite on host CPU, tagged in every metric line
         _BACKEND_TAG = "cpu_fallback"
-        print(f"bench: device claim gave up after "
-              f"{time.time() - claim_t0:.0f}s (cap {claim_cap:.0f}s, {e!r}); "
-              "falling back to CPU", file=sys.stderr, flush=True)
+        print("bench: CPU fallback forced by orchestrator (previous "
+              "device claim outlived its cap)", file=sys.stderr, flush=True)
         import jax
 
         jax.config.update("jax_platforms", "cpu")
-        try:
-            from jax._src import xla_bridge as xb
-
-            xb._clear_backends()
-        except Exception:
-            pass
         devs = jax.devices()
-    finally:
-        claimed_evt.set()
+    else:
+        # claim-progress heartbeat: even if the claim pends until the
+        # driver kills us, the stderr tail shows how long it was pending
+        claimed_evt = threading.Event()
+
+        def _claim_heartbeat():
+            while not claimed_evt.wait(30.0):
+                print(f"bench: device claim pending "
+                      f"{time.time() - claim_t0:.0f}s",
+                      file=sys.stderr, flush=True)
+
+        threading.Thread(target=_claim_heartbeat, daemon=True).start()
+        # the claim gets at most CLAIM_BUDGET_S (and never more than what
+        # the global deadline leaves): past that, a CPU run with a tagged
+        # backend beats an empty perf trajectory
+        claim_cap = claim_cap_s(global_deadline - time.time())
+        try:
+            devs = _devices_with_retry(max_wait=claim_cap)
+        except Exception as e:  # noqa: BLE001 — claim stalled: CPU fallback
+            _BACKEND_TAG = "cpu_fallback"
+            print(f"bench: device claim gave up after "
+                  f"{time.time() - claim_t0:.0f}s (cap {claim_cap:.0f}s, "
+                  f"{e!r}); falling back to CPU",
+                  file=sys.stderr, flush=True)
+            import jax
+
+            jax.config.update("jax_platforms", "cpu")
+            try:
+                from jax._src import xla_bridge as xb
+
+                xb._clear_backends()
+            except Exception:
+                pass
+            devs = jax.devices()
+        finally:
+            claimed_evt.set()
     print(f"bench: device claim took {time.time() - claim_t0:.0f}s",
           file=sys.stderr, flush=True)
     # the run budget is everything left until the global deadline — claim
@@ -876,18 +1006,28 @@ def run_child() -> int:
 
 
 def _stream_attempt(env: dict, done: set, forwarded: set,
-                    global_deadline: float) -> None:
+                    global_deadline: float,
+                    force_cpu: bool = False) -> bool:
     """One child attempt; forward fresh metric lines as they appear.
 
     Lines reach our stdout the moment the child prints them, so a hang or
     parent-side kill can no longer discard already-measured metrics.
-    While the device claim is pending the only deadline is the GLOBAL one
-    (killing a pending claim re-queues it — the r3/r4 churn failure);
-    after the claim an optional per-attempt cap applies (test knob)."""
+
+    Claim-phase watchdog: the child's own claim cap only works when
+    backend init FAILS (its retry loop checks the deadline between
+    attempts); a jax.devices() call wedged INSIDE init never returns to
+    that check (BENCH_r05: heartbeat to 1350s, 0/8 benches).  So the
+    parent gives the claim `claim_cap_s` plus a grace (the in-process
+    fallback keeps queue position and gets first shot), then kills the
+    wedged child.  Returns False in exactly that case — the caller
+    relaunches with the tagged CPU fallback forced.  Post-claim, an
+    optional per-attempt cap applies (test knob)."""
     env = dict(env)
     env[_CHILD_ENV] = "1"
     env[_SKIP_ENV] = ",".join(sorted(done))
     env[_DEADLINE_ENV] = str(global_deadline - 15)
+    if force_cpu:
+        env[_FORCE_CPU_ENV] = "1"
     proc = subprocess.Popen(
         [sys.executable, "-u", os.path.abspath(__file__)], env=env,
         cwd=os.path.dirname(os.path.abspath(__file__)) or ".",
@@ -915,13 +1055,29 @@ def _stream_attempt(env: dict, done: set, forwarded: set,
             sys.stdout.write(line)
             sys.stdout.flush()
 
-    deadline = global_deadline  # claim phase: only the global budget ends it
+    # claim phase: the child gets its claim cap + grace, bounded by the
+    # global budget; a child that never reports __devices__ inside that
+    # window is wedged in backend init and gets killed (-> forced-CPU
+    # relaunch).  A forced-CPU child skips the claim, so only the global
+    # deadline applies.
+    claim_deadline = global_deadline if force_cpu else min(
+        global_deadline,
+        time.time() + claim_cap_s(global_deadline - time.time())
+        + CLAIM_KILL_GRACE_S)
+    deadline = claim_deadline
     claimed = False
+    claim_timed_out = False
     while True:
         try:
             line = q.get(timeout=max(0.1, deadline - time.time()))
         except queue.Empty:
-            phase = "run budget" if claimed else "global budget (claim pending)"
+            if claimed:
+                phase = "run budget"
+            elif time.time() >= global_deadline:
+                phase = "global budget (claim pending)"
+            else:
+                phase = "claim cap (device claim wedged in backend init)"
+                claim_timed_out = True
             print(f"bench: attempt exceeded its {phase}; killing child "
                   "(metrics so far already forwarded)",
                   file=sys.stderr, flush=True)
@@ -952,6 +1108,7 @@ def _stream_attempt(env: dict, done: set, forwarded: set,
         proc.wait(timeout=30)
     except subprocess.TimeoutExpired:
         proc.kill()
+    return not claim_timed_out
 
 
 def main() -> int:
@@ -960,6 +1117,7 @@ def main() -> int:
     all_names = {b.__name__ for b in BENCHES}
     done: set = set(filter(None, os.environ.get(_SKIP_ENV, "").split(",")))
     forwarded: set = set()
+    force_cpu = os.environ.get(_FORCE_CPU_ENV) == "1"
     global_deadline = time.time() + GLOBAL_BUDGET_S
     for attempt in range(1, MAX_ATTEMPTS + 1):
         if done >= all_names:
@@ -968,7 +1126,15 @@ def main() -> int:
             print("bench: global budget exhausted", file=sys.stderr,
                   flush=True)
             break
-        _stream_attempt(os.environ, done, forwarded, global_deadline)
+        claim_ok = _stream_attempt(os.environ, done, forwarded,
+                                   global_deadline, force_cpu=force_cpu)
+        if not claim_ok:
+            # the claim wedged past its cap: every further attempt runs
+            # the tagged CPU fallback instead of re-queuing a claim that
+            # already burned a third of the budget
+            force_cpu = True
+            print("bench: forcing tagged CPU fallback for remaining "
+                  "attempts", file=sys.stderr, flush=True)
         if done >= all_names:
             return 0
         print(f"bench attempt {attempt}: {len(done)}/{len(all_names)} "

@@ -358,19 +358,64 @@ def cmd_warmup(args) -> int:
     else:
         raise SystemExit("warmup needs --model <conf.json | checkpoint dir>")
     net.set_compile_cache(args.compile_cache)
-    shapes = []
-    for spec in args.shapes.split(","):
-        spec = spec.strip()
-        if not spec:
-            continue
-        dims = tuple(int(d) for d in spec.split("x"))
-        shapes.append(dims[0] if len(dims) == 1 else dims)
+    shapes = _parse_shapes(args.shapes)
     if not shapes:
         raise SystemExit("warmup needs --shapes (e.g. 256,1024 or 32x784)")
     entries = tuple(e.strip() for e in args.entries.split(",") if e.strip())
     summary = net.warmup(shapes, entries=entries, train=args.train)
     summary["disk_cache"] = _disk_stats(net)
     print(json.dumps(summary))
+    return 0
+
+
+def _parse_shapes(spec: str):
+    """'256,1024' or '32x784' -> [int batch | full shape tuple, ...]."""
+    shapes = []
+    for part in (spec or "").split(","):
+        part = part.strip()
+        if not part:
+            continue
+        dims = tuple(int(d) for d in part.split("x"))
+        shapes.append(dims[0] if len(dims) == 1 else dims)
+    return shapes
+
+
+def _build_server(args):
+    """serve subcommand minus the blocking loop (testable): load the
+    checkpoint, attach the compile cache, warm the declared buckets, and
+    start the gateway.  Returns (net, server, startup-summary dict)."""
+    net = _load_model(args.model)
+    _attach_compile_cache(net, args)
+    shapes = _parse_shapes(args.shapes)
+    warmed = None
+    if shapes:
+        # warm BEFORE listening: with a populated --compile-cache these
+        # are disk restores, and steady-state serving (requests padding
+        # into the warmed buckets) does zero fresh compiles
+        warmed = net.warmup(shapes, entries=("output",))["shapes"]
+    server = net.serve(host=args.host, port=args.port,
+                       max_delay_ms=args.max_delay_ms,
+                       max_pending=args.max_pending,
+                       max_batch_rows=args.max_batch_rows,
+                       batching=not args.no_batching)
+    summary = {"url": server.url, "warmed": warmed,
+               "fresh_compiles": net.infer_cache.stats.misses,
+               "batching": not args.no_batching,
+               "disk_cache": _disk_stats(net)}
+    return net, server, summary
+
+
+def cmd_serve(args) -> int:
+    import threading
+
+    _, server, summary = _build_server(args)
+    print(json.dumps(summary), flush=True)
+    try:
+        threading.Event().wait()  # serve until interrupted
+    except KeyboardInterrupt:
+        pass
+    finally:
+        server.stop()
     return 0
 
 
@@ -445,6 +490,39 @@ def build_parser() -> argparse.ArgumentParser:
     w.add_argument("--train", action="store_true",
                    help="also compile the train step for each shape")
     w.set_defaults(fn=cmd_warmup)
+
+    s = sub.add_parser("serve",
+                       help="micro-batching HTTP gateway: POST "
+                            "/v1/predict + GET /v1/stats")
+    s.add_argument("--model", required=True,
+                   help="checkpoint dir (or conf JSON) to serve")
+    s.add_argument("--compile-cache", dest="compile_cache", default=None,
+                   metavar="DIR",
+                   help="persistent compile cache; warm it first with the "
+                        "warmup subcommand so serving starts with zero "
+                        "fresh compiles")
+    s.add_argument("--shapes", default="64",
+                   help="row buckets to precompile before listening "
+                        "(comma-separated, like warmup --shapes); '' "
+                        "skips warmup")
+    s.add_argument("--host", default="127.0.0.1")
+    s.add_argument("--port", type=int, default=0,
+                   help="0 picks an ephemeral port (printed in the "
+                        "startup JSON)")
+    s.add_argument("--max-delay-ms", dest="max_delay_ms", type=float,
+                   default=3.0,
+                   help="how long a request may wait for batch co-riders")
+    s.add_argument("--max-pending", dest="max_pending", type=int,
+                   default=1024,
+                   help="queued-request bound; beyond it requests get 503")
+    s.add_argument("--max-batch-rows", dest="max_batch_rows", type=int,
+                   default=None,
+                   help="cap on coalesced rows per device call (default: "
+                        "largest warmed bucket)")
+    s.add_argument("--no-batching", dest="no_batching", action="store_true",
+                   help="bypass the micro-batcher (per-request device "
+                        "calls; the bench_serve control arm)")
+    s.set_defaults(fn=cmd_serve)
     return ap
 
 

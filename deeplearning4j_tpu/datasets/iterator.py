@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import queue
 import threading
+import time
 from typing import Iterator, List, NamedTuple, Optional
 
 import numpy as np
@@ -301,6 +302,7 @@ class PrefetchIterator:
         self._queue: Optional[queue.Queue] = None
         self._thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
+        self._lock = threading.Lock()
 
     # -- transfer ----------------------------------------------------------
     def _transfer(self, item):
@@ -340,7 +342,7 @@ class PrefetchIterator:
         except BaseException as e:  # noqa: BLE001 — re-raised at next()
             self._put(q, stop, (self._ERROR, e))
 
-    def _start(self) -> None:
+    def _start_unlocked(self) -> None:
         self.close()  # tear down any previous run
         if hasattr(self.base, "reset"):
             self.base.reset()
@@ -351,17 +353,56 @@ class PrefetchIterator:
             name="dl4j-prefetch", daemon=True)
         self._thread.start()
 
+    def start(self) -> None:
+        """(Re)start the pipeline; `__iter__` / the first `pull()` call
+        this automatically."""
+        with self._lock:
+            self._start_unlocked()
+
     # -- consumer ----------------------------------------------------------
+    def pull(self):
+        """Return the next prefetched batch; thread-safe.
+
+        Any number of consumer threads may call this against one running
+        pipeline — each batch is delivered to exactly one of them.  Raises
+        StopIteration at end-of-stream (re-queuing the DONE marker so every
+        concurrent consumer terminates) or when `close()` is called
+        mid-iteration; a worker error is raised at exactly one consumer and
+        stops the rest.  Consumers always park on a timed get and re-check
+        the stop event, so a cross-thread `close()` can never strand a
+        blocked consumer."""
+        with self._lock:
+            if self._queue is None:
+                self._start_unlocked()
+            q, stop = self._queue, self._stop
+        while True:
+            if stop.is_set():
+                raise StopIteration
+            try:
+                kind, payload = q.get(timeout=0.05)
+            except queue.Empty:
+                continue
+            if kind == self._ITEM:
+                return payload
+            if kind == self._ERROR:
+                stop.set()  # terminal: release the other consumers too
+                raise payload
+            # DONE: put it back so every other consumer also terminates
+            # (worker has exited, so the freed slot can't be re-filled)
+            try:
+                q.put_nowait((self._DONE, None))
+            except queue.Full:
+                pass
+            raise StopIteration
+
     def __iter__(self):
-        self._start()
+        self.start()
         try:
             while True:
-                kind, payload = self._queue.get()
-                if kind == self._DONE:
+                try:
+                    yield self.pull()
+                except StopIteration:
                     break
-                if kind == self._ERROR:
-                    raise payload
-                yield payload
         finally:
             self.close()
 
@@ -370,19 +411,26 @@ class PrefetchIterator:
         iteration restarts it (and resets the wrapped iterator)."""
         self.close()
 
-    def close(self) -> None:
-        """Stop the worker and join it (idempotent; safe mid-iteration)."""
+    def close(self, join_timeout: float = 5.0) -> None:
+        """Stop the worker and join it (idempotent; safe mid-iteration,
+        including from a thread other than the consumer's)."""
         self._stop.set()
         thread, self._thread = self._thread, None
+        q, self._queue = self._queue, None
         if thread is not None:
             # drain so a worker parked on a full queue sees the stop flag
-            while thread.is_alive():
-                try:
-                    self._queue.get_nowait()
-                except queue.Empty:
-                    pass
+            deadline = time.monotonic() + join_timeout
+            while thread.is_alive() and time.monotonic() < deadline:
+                if q is not None:
+                    try:
+                        q.get_nowait()
+                    except queue.Empty:
+                        pass
                 thread.join(timeout=0.05)
-        self._queue = None
+            # a worker wedged inside the wrapped iterable (e.g. a data
+            # source blocked on I/O) is abandoned as a daemon rather than
+            # blocking shutdown: stop is set, so it exits the moment its
+            # blocking call returns
 
     def __enter__(self) -> "PrefetchIterator":
         return self

@@ -32,6 +32,18 @@ from jax.experimental.pallas import tpu as pltpu
 
 from deeplearning4j_tpu.nd.attention import blockwise_attention
 
+# jax 0.5 renamed TPUCompilerParams -> CompilerParams and grew a
+# has_side_effects field; build the params compatibly for either version
+_CompilerParams = getattr(pltpu, "CompilerParams", None) or getattr(
+    pltpu, "TPUCompilerParams")
+
+
+def _compiler_params(**kw):
+    import dataclasses
+
+    fields = {f.name for f in dataclasses.fields(_CompilerParams)}
+    return _CompilerParams(**{k: v for k, v in kw.items() if k in fields})
+
 _NEG_BIG = -1e30
 
 
@@ -285,6 +297,6 @@ def scatter_add_rows(table, indices, updates,
         out_shape=jax.ShapeDtypeStruct(table.shape, table.dtype),
         grid_spec=grid_spec,
         input_output_aliases={2: 0},
-        compiler_params=pltpu.CompilerParams(has_side_effects=True),
+        compiler_params=_compiler_params(has_side_effects=True),
         interpret=_interpret(interpret),
     )(indices.astype(jnp.int32), updates, table)

@@ -57,11 +57,21 @@ def _layer_forward(impl, c, params, h, key, training):
     """One layer's forward, optionally under jax.checkpoint (conf.remat):
     activations inside the layer are recomputed during backward instead of
     stored, trading ~1/3 extra FLOPs for HBM capacity — the standard TPU
-    trick for fitting larger batches (SURVEY §7 / scaling-book recipe)."""
-    if c.remat and training:
+    trick for fitting larger batches (SURVEY §7 / scaling-book recipe).
+
+    Training forwards go through jax.checkpoint with BOTH remat settings
+    (remat=False saves every residual, so nothing is recomputed): the
+    checkpoint boundary fixes the layer's backward to one
+    linearize-then-transpose structure, whose input-cotangent summation
+    order differs from plain trace-through autodiff by float noise.  One
+    shared structure means flipping conf.remat changes memory, never a
+    single grad bit."""
+    if training:
+        policy = (None if c.remat
+                  else jax.checkpoint_policies.everything_saveable)
         return jax.checkpoint(
-            lambda p, hh, kk: impl.forward(p, c, hh, kk, training)
-        )(params, h, key)
+            lambda p, hh, kk: impl.forward(p, c, hh, kk, training),
+            policy=policy)(params, h, key)
     return impl.forward(params, c, h, key, training)
 
 
@@ -411,6 +421,28 @@ class MultiLayerNetwork:
             "step_cache": self.step_cache.stats.as_dict(),
             "infer_cache": self.infer_cache.stats.as_dict(),
         }
+
+    # -- serving ------------------------------------------------------------
+    def serve(self, host: str = "127.0.0.1", port: int = 0,
+              max_delay_ms: float = 3.0, max_pending: int = 1024,
+              max_batch_rows=None, batching: bool = True):
+        """Start the micro-batching HTTP gateway over this network
+        (`serving.ModelServer`): POST /v1/predict coalesces concurrent
+        requests into one bucketed infer-cache call per flush, GET
+        /v1/stats reports queue depth / batch histogram / latency
+        percentiles / fresh-compile count.  Call `warmup()` (or attach a
+        warmed `set_compile_cache` dir) first so the first request is
+        served without a fresh compile.  Returns the started server;
+        `server.stop()` shuts it down."""
+        from deeplearning4j_tpu.serving.server import ModelServer
+
+        if self.params is None:
+            self.init()
+        return ModelServer(self, host=host, port=port,
+                           max_delay_ms=max_delay_ms,
+                           max_pending=max_pending,
+                           max_batch_rows=max_batch_rows,
+                           batching=batching).start()
 
     # -- inference ---------------------------------------------------------
     def _serve_cached(self, x) -> bool:

@@ -83,7 +83,8 @@ class InferCache(CompiledProgramCache):
         fn = self._get(key, lambda: _output_program(conf), args)
         if compile_only:
             return None
-        self.stats.steps += 1
+        with self._lock:
+            self.stats.steps += 1
         return truncate_rows(fn(*args), bucket, n)
 
     def feed_forward(self, conf, params, x, compile_only: bool = False):
@@ -97,7 +98,8 @@ class InferCache(CompiledProgramCache):
         fn = self._get(key, lambda: _feed_forward_program(conf), args)
         if compile_only:
             return None
-        self.stats.steps += 1
+        with self._lock:
+            self.stats.steps += 1
         return [truncate_rows(a, bucket, n) for a in fn(*args)]
 
     def loss(self, conf, params, x, y, compile_only: bool = False):
@@ -113,7 +115,8 @@ class InferCache(CompiledProgramCache):
         fn = self._get(key, lambda: _loss_program(conf), args)
         if compile_only:
             return None
-        self.stats.steps += 1
+        with self._lock:
+            self.stats.steps += 1
         return fn(*args)
 
 

@@ -53,6 +53,7 @@ from __future__ import annotations
 
 import hashlib
 import logging
+import threading
 import time
 from typing import Callable, Dict, List, Optional, Tuple
 
@@ -146,6 +147,11 @@ class CompiledProgramCache:
         self._donate = donate
         self._persist = persist
         self.stats = StepCacheStats()
+        # the serving gateway (and its batching-off control arm) reaches
+        # this cache from many threads at once: lookup, bucket growth and
+        # stats mutate under one lock (program EXECUTION does not — jax
+        # dispatch is thread-safe and must overlap)
+        self._lock = threading.RLock()
 
     # -- persistence --------------------------------------------------------
     @property
@@ -162,17 +168,18 @@ class CompiledProgramCache:
         """Smallest known bucket >= n; otherwise n becomes a new bucket
         (fixed bucket sets never grow — an oversize batch runs unpadded
         as its own bucket, logged)."""
-        for b in self._buckets:
-            if b >= n:
-                return b
-        if self._fixed_buckets and self._buckets:
-            log.info("%s: batch of %d rows exceeds the fixed "
-                     "buckets %s; running unpadded", self.kind, n,
-                     self._buckets)
-        else:
-            self._buckets.append(n)
-            self._buckets.sort()
-        return n
+        with self._lock:
+            for b in self._buckets:
+                if b >= n:
+                    return b
+            if self._fixed_buckets and self._buckets:
+                log.info("%s: batch of %d rows exceeds the fixed "
+                         "buckets %s; running unpadded", self.kind, n,
+                         self._buckets)
+            else:
+                self._buckets.append(n)
+                self._buckets.sort()
+            return n
 
     @property
     def buckets(self) -> Tuple[int, ...]:
@@ -180,11 +187,12 @@ class CompiledProgramCache:
 
     # -- program lookup -----------------------------------------------------
     def _fingerprint(self, conf) -> str:
-        fp = self._fingerprints.get(id(conf))
-        if fp is None:
-            fp = conf_fingerprint(conf)
-            self._fingerprints[id(conf)] = fp
-        return fp
+        with self._lock:
+            fp = self._fingerprints.get(id(conf))
+            if fp is None:
+                fp = conf_fingerprint(conf)
+                self._fingerprints[id(conf)] = fp
+            return fp
 
     def _donate_argnums(self) -> Tuple[int, ...]:
         donate = self._donate
@@ -195,7 +203,14 @@ class CompiledProgramCache:
     def _get(self, key: Tuple, build: Callable[[], Callable], args: Tuple):
         """Return the compiled executable for `key`: memory hit, else
         disk hit (persistent store attached), else a timed fresh
-        trace+compile with disk write-back."""
+        trace+compile with disk write-back.  Serialized under the cache
+        lock: two threads racing a miss would otherwise compile (and
+        persist) the same program twice."""
+        with self._lock:
+            return self._get_locked(key, build, args)
+
+    def _get_locked(self, key: Tuple, build: Callable[[], Callable],
+                    args: Tuple):
         fn = self._programs.get(key)
         if fn is not None:
             self.stats.hits += 1
@@ -304,9 +319,11 @@ class CompiledProgramCache:
         return wrapped
 
     def clear(self) -> None:
-        self._programs.clear()
-        self._buckets = sorted(self._buckets) if self._fixed_buckets else []
-        self.stats = StepCacheStats()
+        with self._lock:
+            self._programs.clear()
+            self._buckets = (sorted(self._buckets) if self._fixed_buckets
+                             else [])
+            self.stats = StepCacheStats()
 
     def __len__(self):
         return len(self._programs)

@@ -1,0 +1,13 @@
+"""Dynamic micro-batching serving gateway (ISSUE 4 tentpole).
+
+Turns many concurrent single-row (or small-batch) predict requests into
+one bucketed device call over the serve-path AOT compile cache
+(`optimize/infer_cache.py`): `MicroBatcher` coalesces, `ModelServer`
+exposes it over HTTP.
+"""
+
+from deeplearning4j_tpu.serving.batcher import (MicroBatcher,
+                                                ServerOverloaded)
+from deeplearning4j_tpu.serving.server import ModelServer
+
+__all__ = ["MicroBatcher", "ModelServer", "ServerOverloaded"]

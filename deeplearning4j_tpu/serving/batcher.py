@@ -1,0 +1,274 @@
+"""Dynamic micro-batching: many concurrent requests, one device call.
+
+Every `MultiLayerNetwork.output()` call dispatches its own XLA program,
+so concurrent callers serialize on dispatch and run at batch-size-1
+arithmetic intensity — the exact regime the TPU datacenter analysis
+(Jouppi et al., 2017) shows starves the MXU.  `MicroBatcher` recovers
+the batch: requests land on a per-(feature-shape, dtype) FIFO from any
+thread, and ONE dispatcher thread drains them into a single
+`net.output()` call that the serve-path compile cache
+(`optimize/infer_cache.py`) pads into its largest fitting row bucket.
+
+Flush policy (classic dynamic batching under a latency SLO):
+  - full bucket: queued rows reach the target batch (the largest known
+    `InferCache` row bucket, capped by `max_batch_rows`), or
+  - deadline: the OLDEST queued request has waited `max_delay_ms`.
+
+Correctness: inference is row-independent (the property the infer
+cache's pad/slice machinery already guarantees bit-exactly — pad rows
+never leak), so each caller's rows in a coalesced batch are bitwise the
+rows a direct `net.output()` call would have returned.
+
+Backpressure: the queue is bounded (`max_pending` requests); beyond it
+`predict()` fails fast with `ServerOverloaded` (HTTP 503 upstream)
+instead of growing memory without bound.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Deque, Dict, Optional, Tuple
+
+import numpy as np
+
+#: coalescing target when no row bucket is known yet and the caller set
+#: no `max_batch_rows` cap
+DEFAULT_TARGET_ROWS = 256
+
+#: rows/s is reported over this trailing window (seconds)
+RATE_WINDOW_S = 10.0
+
+
+class ServerOverloaded(RuntimeError):
+    """The gateway's pending queue is full — fail fast (HTTP 503)."""
+
+
+class _Pending:
+    """One enqueued request: its rows, completion event, and timing."""
+
+    __slots__ = ("x", "rows", "done", "result", "error", "t_enqueue")
+
+    def __init__(self, x):
+        self.x = x
+        self.rows = int(x.shape[0])
+        self.done = threading.Event()
+        self.result = None
+        self.error: Optional[BaseException] = None
+        self.t_enqueue = time.monotonic()
+
+
+class MicroBatcher:
+    """Coalesces concurrent predict requests into bucketed device calls.
+
+    net:            the `MultiLayerNetwork` to serve (its `infer_cache`
+                    provides the bucketed AOT programs).
+    max_delay_ms:   latency budget a request may wait for co-riders
+                    before the dispatcher flushes anyway.
+    max_pending:    bound on queued (not yet dispatched) requests;
+                    beyond it `predict()` raises `ServerOverloaded`.
+    max_batch_rows: cap on coalesced rows per device call; defaults to
+                    the largest known infer-cache bucket (so a warmed
+                    server batches exactly into its warmed program), or
+                    `DEFAULT_TARGET_ROWS` when no bucket exists yet.
+    """
+
+    def __init__(self, net, max_delay_ms: float = 3.0,
+                 max_pending: int = 1024,
+                 max_batch_rows: Optional[int] = None,
+                 auto_start: bool = True):
+        self.net = net
+        self.max_delay_s = float(max_delay_ms) / 1000.0
+        self.max_pending = int(max_pending)
+        self.max_batch_rows = max_batch_rows
+        self._auto_start = auto_start
+        self._cv = threading.Condition()
+        # key = (feature shape beyond axis 0, dtype): only requests that
+        # concatenate into one well-formed batch share a queue
+        self._queues: Dict[Tuple, Deque[_Pending]] = {}
+        self._pending = 0
+        self._stop = False
+        self._thread: Optional[threading.Thread] = None
+        # -- stats (guarded by _cv's lock) ---------------------------------
+        self._t_start = time.monotonic()
+        self._reqs_done = 0
+        self._rows_done = 0
+        self._batch_hist: Dict[int, int] = {}   # flushed batch rows -> count
+        self._latencies: Deque[float] = deque(maxlen=4096)  # seconds
+        self._recent: Deque[Tuple[float, int]] = deque()    # (t_done, rows)
+
+    # -- lifecycle ----------------------------------------------------------
+    def start(self) -> "MicroBatcher":
+        with self._cv:
+            if self._thread is not None:
+                return self
+            self._stop = False
+            self._thread = threading.Thread(
+                target=self._dispatch_loop, name="dl4j-microbatch",
+                daemon=True)
+            self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        """Stop the dispatcher; queued requests are drained (served)
+        before the thread exits."""
+        with self._cv:
+            self._stop = True
+            thread, self._thread = self._thread, None
+            self._cv.notify_all()
+        if thread is not None:
+            thread.join(timeout=30.0)
+
+    # -- request side (any thread) ------------------------------------------
+    def predict(self, x, timeout: Optional[float] = None) -> np.ndarray:
+        """Enqueue `x` ([rows, ...features]) and block until its output
+        activations come back from a coalesced device call.  Raises
+        `ServerOverloaded` when `max_pending` requests are already
+        queued, `TimeoutError` past `timeout` seconds."""
+        x = np.asarray(x)
+        if x.ndim < 2:
+            raise ValueError(
+                f"predict expects batched input [rows, ...features]; "
+                f"got shape {x.shape}")
+        req = _Pending(x)
+        key = (x.shape[1:], str(x.dtype))
+        with self._cv:
+            if self._pending >= self.max_pending:
+                raise ServerOverloaded(
+                    f"{self._pending} requests already pending "
+                    f"(max_pending={self.max_pending})")
+            self._queues.setdefault(key, deque()).append(req)
+            self._pending += 1
+            self._cv.notify_all()
+        if self._thread is None and self._auto_start:
+            self.start()
+        if not req.done.wait(timeout):
+            raise TimeoutError(
+                f"no response within {timeout}s (queue depth "
+                f"{self.queue_depth()})")
+        if req.error is not None:
+            raise req.error
+        return req.result
+
+    def queue_depth(self) -> int:
+        with self._cv:
+            return self._pending
+
+    # -- dispatcher (one thread) --------------------------------------------
+    def _target_rows(self) -> int:
+        """Coalescing target: the largest known infer-cache row bucket
+        (so flushed-full batches hit an already-compiled program), capped
+        by `max_batch_rows`."""
+        buckets = self.net.infer_cache.buckets
+        cap = self.max_batch_rows
+        fitting = [b for b in buckets if cap is None or b <= cap]
+        if fitting:
+            return max(fitting)
+        return cap if cap is not None else DEFAULT_TARGET_ROWS
+
+    def _oldest_key(self):
+        """The queue whose head request has waited longest (FIFO across
+        shapes: no shape can be starved by a busier one)."""
+        best_key, best_t = None, None
+        for key, q in self._queues.items():
+            if q and (best_t is None or q[0].t_enqueue < best_t):
+                best_key, best_t = key, q[0].t_enqueue
+        return best_key
+
+    def _dispatch_loop(self) -> None:
+        while True:
+            with self._cv:
+                key = self._oldest_key()
+                if key is None:
+                    if self._stop:
+                        return
+                    self._cv.wait()
+                    continue
+                q = self._queues[key]
+                target = self._target_rows()
+                queued_rows = sum(r.rows for r in q)
+                deadline = q[0].t_enqueue + self.max_delay_s
+                now = time.monotonic()
+                # stopping: drain immediately rather than wait out SLOs
+                if (queued_rows < target and now < deadline
+                        and not self._stop):
+                    self._cv.wait(timeout=deadline - now)
+                    continue
+                batch = [q.popleft()]
+                rows = batch[0].rows
+                # head-of-line FIFO: take co-riders while they still fit
+                while q and rows + q[0].rows <= target:
+                    batch.append(q.popleft())
+                    rows += batch[-1].rows
+                self._pending -= len(batch)
+            self._execute(batch)
+
+    def _execute(self, batch) -> None:
+        xs = [r.x for r in batch]
+        xb = xs[0] if len(xs) == 1 else np.concatenate(xs, axis=0)
+        try:
+            out = np.asarray(self.net.output(xb))
+            err = None
+        except BaseException as e:  # noqa: BLE001 — delivered per request
+            out, err = None, e
+        t_done = time.monotonic()
+        offset = 0
+        for r in batch:
+            if err is not None:
+                r.error = err
+            else:
+                r.result = out[offset:offset + r.rows]
+                offset += r.rows
+            r.done.set()
+        with self._cv:
+            rows = sum(r.rows for r in batch)
+            self._reqs_done += len(batch)
+            self._rows_done += rows
+            self._batch_hist[rows] = self._batch_hist.get(rows, 0) + 1
+            self._recent.append((t_done, rows))
+            while self._recent and t_done - self._recent[0][0] > RATE_WINDOW_S:
+                self._recent.popleft()
+            for r in batch:
+                self._latencies.append(t_done - r.t_enqueue)
+
+    # -- observability -------------------------------------------------------
+    @staticmethod
+    def _percentile(sorted_vals, q: float) -> float:
+        if not sorted_vals:
+            return 0.0
+        idx = min(len(sorted_vals) - 1,
+                  max(0, int(round(q * (len(sorted_vals) - 1)))))
+        return sorted_vals[idx]
+
+    def stats(self) -> dict:
+        """Gateway counters for `/v1/stats`: queue depth, batch-size
+        histogram, latency percentiles, rows/s, and the fresh-compile
+        count (infer-cache misses — a warmed server serves with 0)."""
+        with self._cv:
+            lat = sorted(self._latencies)
+            now = time.monotonic()
+            recent_rows = sum(r for t, r in self._recent
+                              if now - t <= RATE_WINDOW_S)
+            window = min(max(now - self._t_start, 1e-9), RATE_WINDOW_S)
+            depth = self._pending
+            reqs, rows = self._reqs_done, self._rows_done
+            hist = {str(k): v for k, v in sorted(self._batch_hist.items())}
+        cache = self.net.infer_cache.stats
+        return {
+            "queue_depth": depth,
+            "max_pending": self.max_pending,
+            "max_delay_ms": self.max_delay_s * 1000.0,
+            "target_rows": self._target_rows(),
+            "requests": reqs,
+            "rows": rows,
+            "rows_per_sec": round(recent_rows / window, 2),
+            "batch_rows_hist": hist,
+            "latency_ms": {
+                "p50": round(self._percentile(lat, 0.50) * 1e3, 3),
+                "p95": round(self._percentile(lat, 0.95) * 1e3, 3),
+                "p99": round(self._percentile(lat, 0.99) * 1e3, 3),
+            },
+            "fresh_compiles": cache.misses,
+            "cache": cache.as_dict(),
+        }

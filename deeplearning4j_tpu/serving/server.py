@@ -1,0 +1,131 @@
+"""HTTP front of the micro-batching gateway (sibling of `ui/server.py`).
+
+  POST /v1/predict   {"features": [[...], ...]} -> {"output": [...], "rows": n}
+                     (503 + {"error": ...} when the gateway queue is full,
+                     504 when a request waits out `request_timeout_s`)
+  GET  /v1/stats     gateway counters (queue depth, batch-size histogram,
+                     p50/p95/p99 latency, rows/s, fresh-compile count) plus
+                     the infer cache's stats block (`disk_hits` etc.), so a
+                     warmed server is observable in one curl.
+
+Handler threads (stdlib `ThreadingHTTPServer`, one per connection) only
+parse JSON and park on the batcher — every device call is made by the
+single dispatcher thread, which is what turns N concurrent clients into
+one bucketed program execution.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+from urllib.parse import urlparse
+
+import numpy as np
+
+from deeplearning4j_tpu.serving.batcher import MicroBatcher, ServerOverloaded
+
+
+class _ServeHandler(BaseHTTPRequestHandler):
+    model_server: "ModelServer" = None
+
+    def _send(self, body, code: int = 200) -> None:
+        data = json.dumps(body).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def _body(self) -> dict:
+        n = int(self.headers.get("Content-Length", 0))
+        return json.loads(self.rfile.read(n) or b"{}")
+
+    def do_GET(self):  # noqa: N802
+        if urlparse(self.path).path == "/v1/stats":
+            self._send(self.model_server.stats())
+        else:
+            self._send({"error": "not found"}, 404)
+
+    def do_POST(self):  # noqa: N802
+        if urlparse(self.path).path != "/v1/predict":
+            self._send({"error": "not found"}, 404)
+            return
+        try:
+            body = self._body()
+            feats = np.asarray(body["features"],
+                               dtype=body.get("dtype", "float32"))
+        except (KeyError, ValueError, json.JSONDecodeError) as e:
+            self._send({"error": f"bad request: {e}"}, 400)
+            return
+        if feats.ndim == 1:  # single example: make it a 1-row batch
+            feats = feats[None, :]
+        try:
+            out = self.model_server.predict(feats)
+        except ServerOverloaded as e:
+            self._send({"error": f"overloaded: {e}"}, 503)
+            return
+        except TimeoutError as e:
+            self._send({"error": f"timed out: {e}"}, 504)
+            return
+        self._send({"output": np.asarray(out).tolist(),
+                    "rows": int(feats.shape[0])})
+
+    def log_message(self, *args):  # quiet
+        pass
+
+
+class ModelServer:
+    """Serve a `MultiLayerNetwork` over HTTP through the micro-batcher.
+
+    batching=False bypasses the gateway (each handler thread calls
+    `net.output` directly) — the control arm of `bench_serve`, and an
+    escape hatch for debugging.
+    """
+
+    def __init__(self, net, host: str = "127.0.0.1", port: int = 0,
+                 max_delay_ms: float = 3.0, max_pending: int = 1024,
+                 max_batch_rows: Optional[int] = None,
+                 batching: bool = True,
+                 request_timeout_s: float = 30.0):
+        self.net = net
+        self.batching = bool(batching)
+        self.request_timeout_s = float(request_timeout_s)
+        self.batcher = MicroBatcher(
+            net, max_delay_ms=max_delay_ms, max_pending=max_pending,
+            max_batch_rows=max_batch_rows, auto_start=False)
+        handler = type("Handler", (_ServeHandler,), {"model_server": self})
+        self.server = ThreadingHTTPServer((host, port), handler)
+        self.port = self.server.server_address[1]
+        self._thread: Optional[threading.Thread] = None
+
+    def predict(self, feats: np.ndarray) -> np.ndarray:
+        if self.batching:
+            return self.batcher.predict(feats,
+                                        timeout=self.request_timeout_s)
+        return np.asarray(self.net.output(feats))
+
+    def stats(self) -> dict:
+        out = self.batcher.stats()
+        out["batching"] = self.batching
+        store = self.net.infer_cache.persist
+        if store is not None:
+            out["compile_cache_dir"] = store.directory
+        return out
+
+    def start(self) -> "ModelServer":
+        self.batcher.start()
+        self._thread = threading.Thread(target=self.server.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self.server.shutdown()
+        self.server.server_close()
+        self.batcher.stop()
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.server.server_address[0]}:{self.port}"

@@ -74,3 +74,123 @@ def test_moving_window_rejects_non_tiling_shapes():
         moving_window_dataset(data, 3, 3)  # 4x4 doesn't tile into 3x3
     with pytest.raises(ValueError):
         moving_window_dataset(_ds(n=2, d=15), 3, 3)  # not square
+
+
+# -- PrefetchIterator threading contract (serving gateway shares these
+# idioms: bounded queue, timed waits + stop event, in-order error
+# propagation, cross-thread shutdown) ------------------------------------
+
+def _prefetch_items(n, rows=2):
+    return [(np.full((rows, 3), i, np.float32),
+             np.full((rows, 1), i, np.float32)) for i in range(n)]
+
+
+def test_prefetch_concurrent_consumers_partition_the_stream():
+    import threading
+
+    from deeplearning4j_tpu.datasets.iterator import PrefetchIterator
+
+    items = _prefetch_items(40)
+    it = PrefetchIterator(items, buffer_batches=2, to_device=False)
+    it.start()
+    got, lock = [], threading.Lock()
+
+    def consume():
+        while True:
+            try:
+                feats, _ = it.pull()
+            except StopIteration:
+                return
+            with lock:
+                got.append(int(feats[0, 0]))
+
+    threads = [threading.Thread(target=consume) for _ in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=10.0)
+        assert not t.is_alive(), "consumer failed to terminate"
+    # every batch delivered exactly once across all consumers
+    assert sorted(got) == list(range(40))
+    it.close()
+
+
+def test_prefetch_cross_thread_close_unblocks_parked_consumer():
+    import threading
+    import time
+
+    from deeplearning4j_tpu.datasets.iterator import PrefetchIterator
+
+    stall = threading.Event()
+
+    def slow_gen():
+        yield (np.zeros((1, 2), np.float32), np.zeros((1, 1), np.float32))
+        stall.wait(timeout=30.0)  # producer hangs: consumer must park
+
+    it = PrefetchIterator(slow_gen(), to_device=False)
+    served = []
+
+    def consume():
+        for feats, _ in it:
+            served.append(feats)
+
+    t = threading.Thread(target=consume)
+    t.start()
+    deadline = time.time() + 5.0
+    while not served and time.time() < deadline:
+        time.sleep(0.01)
+    assert served, "first batch never arrived"
+    # close from another thread, while the consumer is parked on get and
+    # the producer is still wedged: the consumer must be released and
+    # close() must not block on the wedged worker
+    it.close(join_timeout=0.2)
+    t.join(timeout=5.0)
+    assert not t.is_alive(), "close() stranded a blocked consumer"
+    stall.set()
+
+
+def test_prefetch_worker_error_releases_all_consumers():
+    import threading
+
+    from deeplearning4j_tpu.datasets.iterator import PrefetchIterator
+
+    def bad_gen():
+        yield (np.zeros((1, 2), np.float32), np.zeros((1, 1), np.float32))
+        raise RuntimeError("boom")
+
+    it = PrefetchIterator(bad_gen(), to_device=False)
+    it.start()
+    outcomes, lock = [], threading.Lock()
+
+    def consume():
+        try:
+            while True:
+                it.pull()
+        except RuntimeError as e:
+            with lock:
+                outcomes.append(("error", str(e)))
+        except StopIteration:
+            with lock:
+                outcomes.append(("stop", None))
+
+    threads = [threading.Thread(target=consume) for _ in range(3)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=10.0)
+        assert not t.is_alive(), "worker error left a consumer blocked"
+    # the error surfaces at exactly one consumer; the rest stop cleanly
+    assert sorted(o[0] for o in outcomes) == ["error", "stop", "stop"]
+    assert ("error", "boom") in outcomes
+    it.close()
+
+
+def test_prefetch_restarts_after_midstream_break():
+    from deeplearning4j_tpu.datasets.iterator import PrefetchIterator
+
+    data = _ds(n=12)
+    it = PrefetchIterator(ListDataSetIterator(data, 4), to_device=False)
+    first = next(iter(it))  # break mid-iteration (generator finalized)
+    assert first.num_examples() == 4
+    # a fresh iteration restarts from the top and serves everything
+    assert sum(b.num_examples() for b in it) == 12
