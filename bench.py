@@ -657,8 +657,12 @@ def bench_serve(devs) -> None:
     net.warmup([clients, 1])
 
     def closed_loop(predict_fn):
+        from deeplearning4j_tpu.reliability import DeadlineExceeded
+
         lat = [[] for _ in range(clients)]
         rows = [0] * clients
+        misses = [0] * clients
+        errors = [0] * clients
         start_evt = threading.Event()
         stop_t = [0.0]
 
@@ -666,9 +670,14 @@ def bench_serve(devs) -> None:
             start_evt.wait()
             while time.perf_counter() < stop_t[0]:
                 t0 = time.perf_counter()
-                predict_fn(xs[i])
-                lat[i].append(time.perf_counter() - t0)
-                rows[i] += 1
+                try:
+                    predict_fn(xs[i])
+                    lat[i].append(time.perf_counter() - t0)
+                    rows[i] += 1
+                except DeadlineExceeded:  # before TimeoutError: subclass
+                    misses[i] += 1
+                except Exception:
+                    errors[i] += 1
 
         threads = [threading.Thread(target=client, args=(i,))
                    for i in range(clients)]
@@ -683,16 +692,18 @@ def bench_serve(devs) -> None:
         all_lat = sorted(v for per in lat for v in per)
         p99 = all_lat[min(len(all_lat) - 1,
                           int(0.99 * (len(all_lat) - 1)))] if all_lat else 0.0
-        return sum(rows) / dt, p99 * 1e3
+        total = max(sum(rows) + sum(misses) + sum(errors), 1)
+        return (sum(rows) / dt, p99 * 1e3,
+                sum(misses) / total, sum(errors) / total)
 
     # batching OFF first (its numbers are the baseline of the headline)
-    off_rows_s, off_p99_ms = closed_loop(
+    off_rows_s, off_p99_ms, off_miss_rate, off_err_rate = closed_loop(
         lambda x: np.asarray(net.output(x)))
 
     misses_before = net.infer_cache.stats.misses  # warmup's prepaid compiles
     batcher = MicroBatcher(net, max_delay_ms=2.0).start()
-    on_rows_s, on_p99_ms = closed_loop(
-        lambda x: batcher.predict(x, timeout=60.0))
+    on_rows_s, on_p99_ms, on_miss_rate, on_err_rate = closed_loop(
+        lambda x: batcher.predict(x, timeout=60.0, deadline_ms=1000.0))
     st = batcher.stats()
     batcher.stop()
 
@@ -702,6 +713,9 @@ def bench_serve(devs) -> None:
           rows_per_sec_unbatched=round(off_rows_s, 1),
           p99_ms_batched=round(on_p99_ms, 2),
           p99_ms_unbatched=round(off_p99_ms, 2),
+          deadline_miss_rate_batched=round(on_miss_rate, 4),
+          error_rate_batched=round(on_err_rate, 4),
+          error_rate_unbatched=round(off_err_rate + off_miss_rate, 4),
           mean_batch_rows=round(st["rows"] / max(
               sum(st["batch_rows_hist"].values()), 1), 2),
           fresh_compiles_during_serving=st["fresh_compiles"] - misses_before,

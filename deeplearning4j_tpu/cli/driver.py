@@ -170,6 +170,18 @@ def cmd_train(args) -> int:
             "pretraining workflows (dbn/deep_autoencoder) need "
             "--runtime local: the mesh data-parallel step is "
             "gradient-only and would silently skip layer-wise pretraining")
+    ckpt_dir = getattr(args, "checkpoint_dir", None)
+    ckpt_every = int(props.get("checkpoint_every", "10"))
+    if ckpt_dir and args.runtime == "mesh":
+        raise SystemExit(
+            "--checkpoint-dir needs --runtime local: the mesh trainer "
+            "keeps updater state across batches, which the "
+            "params+RNG-key checkpoint does not capture yet")
+    if ckpt_dir and (deep_ae or conf.pretrain):
+        raise SystemExit(
+            "--checkpoint-dir does not support pretraining recipes "
+            "(dbn/deep_autoencoder): their multi-phase schedule is not "
+            "batch-cursor resumable")
     import time as _time
     t_train = _time.perf_counter()
     n_trained = data.num_examples() * epochs
@@ -221,6 +233,35 @@ def cmd_train(args) -> int:
             fit_deep_autoencoder(net, data.features)
             for _ in range(epochs - 1):
                 net.finetune(data.features, data.features)
+        elif not deep_ae and ckpt_dir:
+            # crash-safe path: ONE flat batch stream spanning every epoch,
+            # so the checkpoint's single batch cursor addresses the whole
+            # run and a restart replays the stream deterministically up to
+            # the saved cursor (then resumes bit-for-bit)
+            from deeplearning4j_tpu.datasets.iterator import (
+                ListDataSetIterator, MultipleEpochsIterator,
+                PrefetchIterator, ReconstructionDataSetIterator)
+            from deeplearning4j_tpu.reliability import TrainingInterrupted
+
+            if epochs > 0:
+                batch = int(props.get("batch", "0"))
+                rows = batch if batch > 0 else data.num_examples()
+                stream = ListDataSetIterator(data, rows)
+                if reconstruction:
+                    stream = ReconstructionDataSetIterator(stream)
+                if epochs > 1:
+                    stream = MultipleEpochsIterator(epochs, stream)
+                try:
+                    net.fit(PrefetchIterator(stream),
+                            checkpoint_dir=ckpt_dir,
+                            checkpoint_every_n_batches=ckpt_every)
+                except TrainingInterrupted as e:
+                    # checkpointed on the way out: report and exit clean
+                    # (a rerun with the same flags resumes at the cursor)
+                    print(json.dumps({"interrupted": True,
+                                      "checkpoint": ckpt_dir,
+                                      "detail": str(e)}), flush=True)
+                    return 0
         elif not deep_ae:
             # plain reconstruction confs (no AE pretrain stack) still
             # train against the inputs
@@ -397,7 +438,13 @@ def _build_server(args):
                        max_delay_ms=args.max_delay_ms,
                        max_pending=args.max_pending,
                        max_batch_rows=args.max_batch_rows,
-                       batching=not args.no_batching)
+                       batching=not args.no_batching,
+                       request_timeout_s=getattr(args, "request_timeout",
+                                                 30.0),
+                       drain_timeout_s=getattr(args, "drain_timeout", 10.0),
+                       default_deadline_ms=getattr(args,
+                                                   "default_deadline_ms",
+                                                   None))
     summary = {"url": server.url, "warmed": warmed,
                "fresh_compiles": net.infer_cache.stats.misses,
                "batching": not args.no_batching,
@@ -406,16 +453,33 @@ def _build_server(args):
 
 
 def cmd_serve(args) -> int:
-    import threading
+    import signal
 
     _, server, summary = _build_server(args)
     print(json.dumps(summary), flush=True)
+    # SIGTERM/SIGINT → graceful drain: the handler only flips an event
+    # (signal-safe); the main thread wakes and runs the bounded drain —
+    # every request accepted before the signal gets a real response
+    prev = {}
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        try:
+            prev[sig] = signal.signal(
+                sig, lambda signum, frame: server.request_stop())
+        except ValueError:
+            pass  # not the main thread (embedded use): explicit stop only
     try:
-        threading.Event().wait()  # serve until interrupted
+        server.wait_for_stop()
     except KeyboardInterrupt:
         pass
     finally:
-        server.stop()
+        server.drain(getattr(args, "drain_timeout", 10.0))
+        for sig, handler in prev.items():
+            signal.signal(sig, handler)
+        st = server.stats()
+        print(json.dumps({"drained": True,
+                          "requests": st.get("requests", 0),
+                          "deadline_misses": st.get("deadline_misses", 0),
+                          "errors": st.get("errors", 0)}), flush=True)
     return 0
 
 
@@ -454,7 +518,15 @@ def build_parser() -> argparse.ArgumentParser:
                         "char_lstm:layers=4,hidden=128)")
     t.add_argument("--runtime", choices=["local", "mesh"], default="local")
     t.add_argument("--properties", default=None,
-                   help="k=v[,k=v...] train properties: epochs, batch, mode")
+                   help="k=v[,k=v...] train properties: epochs, batch, "
+                        "mode, checkpoint_every (batches between "
+                        "checkpoints with --checkpoint-dir; default 10)")
+    t.add_argument("--checkpoint-dir", dest="checkpoint_dir", default=None,
+                   metavar="DIR",
+                   help="crash-safe training: checkpoint params + RNG key "
+                        "+ batch cursor here every checkpoint_every "
+                        "batches and on SIGTERM; rerunning with the same "
+                        "flags auto-resumes at the saved cursor")
     t.set_defaults(fn=cmd_train)
 
     te = sub.add_parser("test", help="evaluate a checkpoint")
@@ -522,6 +594,19 @@ def build_parser() -> argparse.ArgumentParser:
     s.add_argument("--no-batching", dest="no_batching", action="store_true",
                    help="bypass the micro-batcher (per-request device "
                         "calls; the bench_serve control arm)")
+    s.add_argument("--drain-timeout", dest="drain_timeout", type=float,
+                   default=10.0, metavar="SECONDS",
+                   help="bound on the SIGTERM graceful drain (stop "
+                        "accepting -> flush queued batches -> exit 0)")
+    s.add_argument("--request-timeout", dest="request_timeout", type=float,
+                   default=30.0, metavar="SECONDS",
+                   help="server-side cap on how long one request may "
+                        "wait for its coalesced result (504 past it)")
+    s.add_argument("--default-deadline-ms", dest="default_deadline_ms",
+                   type=float, default=None, metavar="MS",
+                   help="deadline applied to requests that carry no "
+                        "deadline_ms of their own; expired requests are "
+                        "evicted before padding and answered 504")
     s.set_defaults(fn=cmd_serve)
     return ap
 

@@ -17,14 +17,32 @@ import gzip
 import hashlib
 import logging
 import os
+import random
 import shutil
 import tarfile
 import time
 import urllib.error
 import urllib.request
-from typing import Dict, Optional
+from typing import Callable, Dict, Optional
 
 log = logging.getLogger("deeplearning4j_tpu")
+
+#: retry backoff envelope: attempt n sleeps jittered
+#: min(BACKOFF_CAP_S, BACKOFF_BASE_S * 2**(n-1)) seconds
+BACKOFF_BASE_S = 0.5
+BACKOFF_CAP_S = 8.0
+
+#: monkeypatchable sleep so retry tests run in milliseconds
+_sleep = time.sleep
+
+
+def backoff_seconds(attempt: int, rng: Callable[[], float] = random.random
+                    ) -> float:
+    """Full-jitter exponential backoff (AWS-style): uniform in
+    (0, min(cap, base * 2**(attempt-1))] — jitter decorrelates a fleet
+    of workers hammering the same recovering mirror."""
+    ceiling = min(BACKOFF_CAP_S, BACKOFF_BASE_S * (2.0 ** (attempt - 1)))
+    return ceiling * max(rng(), 1e-3)
 
 # canonical sources (the reference's trainingFilesURL etc.); override with
 # base_url= or the DL4J_MNIST_URL / DL4J_LFW_URL / DL4J_CIFAR10_URL /
@@ -74,14 +92,20 @@ def sha256_of(path: str, chunk: int = 1 << 20) -> str:
 
 def download_file(url: str, dest: str, sha256: Optional[str] = None,
                   retries: int = 3, timeout: float = 30.0,
-                  force: bool = False) -> str:
+                  force: bool = False, opener=None) -> str:
     """Fetch `url` into `dest` with checksum verification.
 
     Already-present files that pass the checksum are kept (the reference's
     `if(!tarFile.isFile())` skip, hardened: a present-but-corrupt file is
     re-downloaded rather than trusted). Writes to `dest + '.part'` then
-    renames, so a crash mid-download leaves no half file at `dest`.
+    renames, so a crash mid-download leaves no half file at `dest`; a
+    failed attempt deletes its partial temp file before backing off.
+
+    Retries sleep full-jitter exponential backoff (`backoff_seconds`)
+    instead of hammering a struggling mirror back-to-back.  `opener`
+    overrides `urllib.request.urlopen` (tests inject flaky fakes).
     """
+    opener = urllib.request.urlopen if opener is None else opener
     if not force and os.path.exists(dest):
         if sha256 is None or sha256_of(dest) == sha256:
             return dest
@@ -91,7 +115,7 @@ def download_file(url: str, dest: str, sha256: Optional[str] = None,
     last_err: Optional[Exception] = None
     for attempt in range(1, retries + 1):
         try:
-            with urllib.request.urlopen(url, timeout=timeout) as r, \
+            with opener(url, timeout=timeout) as r, \
                     open(tmp, "wb") as f:
                 shutil.copyfileobj(r, f)
             if sha256 is not None:
@@ -108,12 +132,15 @@ def download_file(url: str, dest: str, sha256: Optional[str] = None,
             raise
         except (urllib.error.URLError, OSError) as e:
             last_err = e
+            if os.path.exists(tmp):  # never leave a partial around
+                os.remove(tmp)
             log.warning("download %s attempt %d/%d failed: %r",
                         url, attempt, retries, e)
             if attempt < retries:
-                time.sleep(min(2.0 ** attempt, 10.0))
-    if os.path.exists(tmp):
-        os.remove(tmp)
+                delay = backoff_seconds(attempt)
+                log.info("download %s: backing off %.2fs before retry",
+                         url, delay)
+                _sleep(delay)
     raise IOError(f"could not download {url}: {last_err!r}")
 
 

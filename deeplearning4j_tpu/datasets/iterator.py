@@ -18,6 +18,7 @@ from typing import Iterator, List, NamedTuple, Optional
 import numpy as np
 
 from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.reliability import faults
 
 
 class DataSetIterator:
@@ -336,6 +337,9 @@ class PrefetchIterator:
             for item in self.base:
                 if stop.is_set():
                     return
+                # armed faults simulate a worker crash mid-epoch; the
+                # exception rides the ERROR message to exactly one consumer
+                faults.fire("prefetch.worker")
                 if not self._put(q, stop, (self._ITEM, self._transfer(item))):
                     return
             self._put(q, stop, (self._DONE, None))

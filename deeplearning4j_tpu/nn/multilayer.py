@@ -21,6 +21,8 @@ from __future__ import annotations
 
 import logging
 import os
+import signal
+import threading
 from functools import partial
 from typing import List, Optional, Sequence
 
@@ -37,6 +39,7 @@ from deeplearning4j_tpu.optimize import solver as solver_mod
 from deeplearning4j_tpu.optimize.infer_cache import InferCache
 from deeplearning4j_tpu.optimize.listeners import dispatch as dispatch_listeners
 from deeplearning4j_tpu.optimize.step_cache import TrainStepCache
+from deeplearning4j_tpu.reliability import TrainingInterrupted
 
 log = logging.getLogger("deeplearning4j_tpu")
 
@@ -335,6 +338,9 @@ class MultiLayerNetwork:
         self.infer_cache = InferCache()
         self.use_infer_cache = True
         self._bn_in_step = False  # did the last finetune advance BN EMA?
+        # SIGTERM/preemption flag: `fit(checkpoint_dir=...)` checks it
+        # between batches and checkpoints-then-exits when set
+        self._stop_training = threading.Event()
         # persistent compile cache: DL4J_COMPILE_CACHE=<dir> attaches the
         # on-disk program store to every network in the process, so
         # restarts skip recompiles (the CLI's --compile-cache flag sets
@@ -425,15 +431,19 @@ class MultiLayerNetwork:
     # -- serving ------------------------------------------------------------
     def serve(self, host: str = "127.0.0.1", port: int = 0,
               max_delay_ms: float = 3.0, max_pending: int = 1024,
-              max_batch_rows=None, batching: bool = True):
+              max_batch_rows=None, batching: bool = True,
+              request_timeout_s: float = 30.0,
+              drain_timeout_s: float = 10.0,
+              default_deadline_ms=None, breaker=None):
         """Start the micro-batching HTTP gateway over this network
         (`serving.ModelServer`): POST /v1/predict coalesces concurrent
         requests into one bucketed infer-cache call per flush, GET
         /v1/stats reports queue depth / batch histogram / latency
-        percentiles / fresh-compile count.  Call `warmup()` (or attach a
-        warmed `set_compile_cache` dir) first so the first request is
-        served without a fresh compile.  Returns the started server;
-        `server.stop()` shuts it down."""
+        percentiles / fresh-compile count / breaker state, GET
+        /healthz + /readyz report liveness/readiness.  Call `warmup()`
+        (or attach a warmed `set_compile_cache` dir) first so the first
+        request is served without a fresh compile.  Returns the started
+        server; `server.stop()` drains gracefully and shuts it down."""
         from deeplearning4j_tpu.serving.server import ModelServer
 
         if self.params is None:
@@ -442,7 +452,11 @@ class MultiLayerNetwork:
                            max_delay_ms=max_delay_ms,
                            max_pending=max_pending,
                            max_batch_rows=max_batch_rows,
-                           batching=batching).start()
+                           batching=batching,
+                           request_timeout_s=request_timeout_s,
+                           drain_timeout_s=drain_timeout_s,
+                           default_deadline_ms=default_deadline_ms,
+                           breaker=breaker).start()
 
     # -- inference ---------------------------------------------------------
     def _serve_cached(self, x) -> bool:
@@ -580,29 +594,115 @@ class MultiLayerNetwork:
             self._bn_in_step = False
         dispatch_listeners(self.listeners, self, scores)
 
-    def fit(self, data, labels=None) -> None:
-        """fit(DataSet/ndarray pair/iterator) — MultiLayerNetwork.fit parity."""
+    def _fit_batch(self, x, y) -> None:
+        """One fit step: pretrain/finetune/BN-EMA for a single batch."""
+        self._bn_in_step = False
+        if self.conf.pretrain:
+            self.pretrain(jnp.asarray(x))
+        if self.conf.backprop:
+            self.finetune(x, y)
+        if has_batchnorm(self.conf) and not self._bn_in_step:
+            # legacy host path (cache disabled / backprop off): true
+            # running EMA across every fit batch via an extra partial
+            # forward.  The cached finetune already folded this into
+            # the compiled step from the solver's own forward.
+            if self._bn_ema_fn is None:
+                self._bn_ema_fn = jax.jit(partial(update_bn_ema, self.conf))
+            self.params = self._bn_ema_fn(self.params, jnp.asarray(x))
+
+    def fit(self, data, labels=None, *, checkpoint_dir: Optional[str] = None,
+            checkpoint_every_n_batches: int = 0,
+            auto_resume: bool = True) -> None:
+        """fit(DataSet/ndarray pair/iterator) — MultiLayerNetwork.fit parity.
+
+        With `checkpoint_dir` the run is crash-safe (ISSUE 5): params +
+        RNG key + batch cursor are checkpointed atomically every
+        `checkpoint_every_n_batches` batches (and at the end), a SIGTERM
+        checkpoints-then-raises `TrainingInterrupted`, and a rerun with
+        the same `checkpoint_dir` and the same batch stream auto-resumes
+        at the saved cursor — reaching bit-identical params to an
+        uninterrupted run at the same total batch count.  (The compiled
+        solver re-initializes its updater inside every per-batch
+        program, so cross-batch training state is exactly params + RNG
+        key; nothing else needs saving.)"""
         if self.params is None:
             self.init()
         if labels is not None:
             batches = [(data, labels)]
         else:
             batches = _as_batches(data)
-        for batch in batches:
-            x, y = batch if isinstance(batch, tuple) else (batch.features, batch.labels)
-            self._bn_in_step = False
-            if self.conf.pretrain:
-                self.pretrain(jnp.asarray(x))
-            if self.conf.backprop:
-                self.finetune(x, y)
-            if has_batchnorm(self.conf) and not self._bn_in_step:
-                # legacy host path (cache disabled / backprop off): true
-                # running EMA across every fit batch via an extra partial
-                # forward.  The cached finetune already folded this into
-                # the compiled step from the solver's own forward.
-                if self._bn_ema_fn is None:
-                    self._bn_ema_fn = jax.jit(partial(update_bn_ema, self.conf))
-                self.params = self._bn_ema_fn(self.params, jnp.asarray(x))
+        if checkpoint_dir is None:
+            for batch in batches:
+                x, y = batch if isinstance(batch, tuple) else (
+                    batch.features, batch.labels)
+                self._fit_batch(x, y)
+            return
+        self._fit_checkpointed(batches, checkpoint_dir,
+                               int(checkpoint_every_n_batches), auto_resume)
+
+    def request_stop_training(self) -> None:
+        """Ask a running `fit(checkpoint_dir=...)` to checkpoint and
+        raise `TrainingInterrupted` after the current batch (what the
+        installed SIGTERM handler calls)."""
+        self._stop_training.set()
+
+    def _save_checkpoint(self, directory: str, batches_done: int) -> None:
+        from deeplearning4j_tpu.parallel import checkpoint as ckpt
+
+        ckpt.save(directory, self.params, conf=self.conf,
+                  step=batches_done,
+                  data_cursor={"batches_done": int(batches_done)},
+                  metadata={"rng_key": np.asarray(
+                      jax.device_get(self._key)).tolist()})
+
+    def _fit_checkpointed(self, batches, checkpoint_dir: str,
+                          every_n: int, auto_resume: bool) -> None:
+        from deeplearning4j_tpu.parallel import checkpoint as ckpt
+
+        start_batch = 0
+        if auto_resume:
+            restored = ckpt.load_resilient(checkpoint_dir,
+                                           like_params=self.params)
+            if restored is not None:
+                params, _, meta = restored
+                self.params = params
+                start_batch = int(
+                    (meta.get("data_cursor") or {}).get("batches_done", 0))
+                rng = (meta.get("metadata") or {}).get("rng_key")
+                if rng is not None:
+                    self._key = jnp.asarray(np.asarray(rng, dtype=np.uint32))
+                log.info("fit: auto-resumed %s at batch %d",
+                         checkpoint_dir, start_batch)
+        self._stop_training.clear()
+        prev_handler, installed = None, False
+        if threading.current_thread() is threading.main_thread():
+            try:
+                prev_handler = signal.signal(
+                    signal.SIGTERM,
+                    lambda signum, frame: self._stop_training.set())
+                installed = True
+            except ValueError:
+                pass  # exotic embedding: no handler, explicit stop only
+        n_done = 0
+        try:
+            for batch in batches:
+                n_done += 1
+                if n_done <= start_batch:
+                    continue  # replaying the resumed prefix of the stream
+                x, y = batch if isinstance(batch, tuple) else (
+                    batch.features, batch.labels)
+                self._fit_batch(x, y)
+                if self._stop_training.is_set():
+                    self._save_checkpoint(checkpoint_dir, n_done)
+                    raise TrainingInterrupted(
+                        f"stop requested: checkpointed {checkpoint_dir} "
+                        f"at batch {n_done}")
+                if every_n > 0 and n_done % every_n == 0:
+                    self._save_checkpoint(checkpoint_dir, n_done)
+            self._save_checkpoint(checkpoint_dir, n_done)
+        finally:
+            if installed:
+                signal.signal(signal.SIGTERM, prev_handler)
 
     # -- parameter vector (distributed/averaging contract) -----------------
     def params_flat(self) -> jnp.ndarray:

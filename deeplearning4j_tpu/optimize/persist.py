@@ -57,6 +57,8 @@ import tempfile
 import time
 from typing import Optional, Tuple
 
+from deeplearning4j_tpu.reliability import faults
+
 log = logging.getLogger("deeplearning4j_tpu")
 
 #: bump to invalidate every existing artifact on a format change
@@ -122,6 +124,20 @@ class PersistentProgramStore:
         self.writes = 0
         self.evictions = 0
         self.corrupt_evicted = 0
+        self.io_errors = 0       # OSErrors downgraded to cache misses
+        self._io_warned = False  # warn ONCE, then count quietly
+
+    def _note_io_error(self, op: str, path: str, exc: BaseException) -> None:
+        """Count a disk-level failure (full disk, yanked NFS) that was
+        downgraded to a plain cache miss.  One warning per store — a
+        dying disk would otherwise flood the log at request rate."""
+        self.io_errors += 1
+        if not self._io_warned:
+            self._io_warned = True
+            log.warning(
+                "compile-cache: disk %s failed (%s: %r); treating as a "
+                "cache miss — further I/O errors counted in "
+                "cache.stats['io_errors'] without logging", op, path, exc)
 
     # -- paths --------------------------------------------------------------
     def path_for(self, key: Tuple) -> str:
@@ -139,12 +155,13 @@ class PersistentProgramStore:
         three also evict the entry so the rewrite is clean."""
         path = self.path_for(key)
         try:
+            faults.fire("persist.read", path=path)
             with open(path, "rb") as f:
                 raw = f.read()
         except (FileNotFoundError, IsADirectoryError):
             return None
         except OSError as e:
-            log.warning("compile-cache: unreadable %s (%s)", path, e)
+            self._note_io_error("read", path, e)
             return None
         try:
             if raw[:8] != _MAGIC:
@@ -197,6 +214,9 @@ class PersistentProgramStore:
                 "blob_sha256": hashlib.sha256(blob).hexdigest(),
             }, sort_keys=True).encode("utf-8")
             payload = _MAGIC + struct.pack(">I", len(header)) + header + blob
+            # a 'corrupt' plan mutates the payload here, so the torn-write
+            # → checksum-evict → recompile loop is testable end to end
+            payload = faults.fire("persist.write", data=payload, path=path)
             fd, tmp = tempfile.mkstemp(dir=self.directory,
                                        suffix=_SUFFIX + ".tmp")
             try:
@@ -208,6 +228,9 @@ class PersistentProgramStore:
             except BaseException:
                 self._remove(tmp)
                 raise
+        except OSError as e:  # full disk / yanked mount: a counted miss
+            self._note_io_error("write", path, e)
+            return False
         except Exception as e:  # noqa: BLE001 — persistence is best-effort
             log.warning("compile-cache: failed to persist %s (%s)", key, e)
             return False

@@ -61,6 +61,7 @@ import jax
 import jax.numpy as jnp
 
 from deeplearning4j_tpu.optimize import solver as solver_mod
+from deeplearning4j_tpu.reliability import faults
 
 log = logging.getLogger("deeplearning4j_tpu")
 
@@ -97,6 +98,7 @@ class StepCacheStats:
         self.disk_hits = 0
         self.disk_write_seconds = 0.0
         self.deserialize_seconds = 0.0
+        self.io_errors = 0  # disk faults downgraded to misses (persist)
 
     @property
     def total_compile_seconds(self) -> float:
@@ -108,7 +110,8 @@ class StepCacheStats:
                 "compile_seconds": round(self.total_compile_seconds, 3),
                 "disk_hits": self.disk_hits,
                 "disk_write_seconds": round(self.disk_write_seconds, 3),
-                "deserialize_seconds": round(self.deserialize_seconds, 3)}
+                "deserialize_seconds": round(self.deserialize_seconds, 3),
+                "io_errors": self.io_errors}
 
     def __repr__(self):
         return f"StepCacheStats({self.as_dict()})"
@@ -221,8 +224,12 @@ class CompiledProgramCache:
         donate = self._donate_argnums()
         if self._persist is not None:
             fn = self._load_from_disk(key, abstract, donate)
+            self.stats.io_errors = self._persist.io_errors
             if fn is not None:
                 return fn
+        # armed 'compile' faults fire here: the one place every fresh
+        # trace+compile (train or infer) funnels through
+        faults.fire("compile", kind=self.kind, key=repr(key))
         self.stats.misses += 1
         t0 = time.perf_counter()
         exported = None
@@ -252,6 +259,7 @@ class CompiledProgramCache:
             tw = time.perf_counter()
             self._persist.store(key, exported)
             self.stats.disk_write_seconds += time.perf_counter() - tw
+            self.stats.io_errors = self._persist.io_errors
         self._programs[key] = fn
         return fn
 

@@ -20,14 +20,19 @@ all leaves are gathered to host first (`jax.device_get`).
 from __future__ import annotations
 
 import json
+import logging
 import os
 import shutil
 import tempfile
 import threading
-from typing import Any, Dict, Optional, Tuple
+from typing import Any, Dict, List, Optional, Tuple
 
 import jax
 import numpy as np
+
+from deeplearning4j_tpu.reliability import faults
+
+log = logging.getLogger("deeplearning4j_tpu")
 
 
 def _flatten_with_paths(tree) -> Dict[str, np.ndarray]:
@@ -46,6 +51,7 @@ def save(directory: str, params, updater=None, *, conf=None, step: int = 0,
     """Write an atomic checkpoint; returns the directory path."""
     if jax.process_index() != 0:
         return directory
+    faults.fire("checkpoint.save", path=directory)
     directory = os.fspath(directory)
     parent = os.path.dirname(os.path.abspath(directory)) or "."
     os.makedirs(parent, exist_ok=True)
@@ -82,18 +88,61 @@ def save(directory: str, params, updater=None, *, conf=None, step: int = 0,
     return directory
 
 
+# async-save bookkeeping: a failing background write must surface at the
+# NEXT save_async() / join_async() call, never vanish with the thread —
+# a checkpoint the trainer believes exists but doesn't is silent data loss
+_async_lock = threading.Lock()
+_async_threads: List[threading.Thread] = []
+_async_errors: List[BaseException] = []
+
+
+def _raise_pending_async_error() -> None:
+    with _async_lock:
+        if not _async_errors:
+            return
+        err = _async_errors.pop(0)
+    raise err
+
+
 def save_async(directory: str, params, updater=None, **kw) -> threading.Thread:
     """Off-thread snapshot (ModelSavingActor behavior): device_get NOW so
-    training can mutate donated buffers, write in the background."""
+    training can mutate donated buffers, write in the background.
+
+    Re-raises the exception of any PREVIOUS async save that failed, so a
+    dying disk stops the run instead of silently dropping checkpoints;
+    `join_async()` flushes and re-raises explicitly."""
+    _raise_pending_async_error()
     params = jax.tree_util.tree_map(lambda x: np.asarray(jax.device_get(x)),
                                     params)
     if updater is not None:
         updater = jax.tree_util.tree_map(
             lambda x: np.asarray(jax.device_get(x)), updater)
-    t = threading.Thread(target=save, args=(directory, params, updater),
-                         kwargs=kw, daemon=True)
+
+    def run():
+        try:
+            save(directory, params, updater, **kw)
+        except BaseException as e:  # noqa: BLE001 — re-raised at next call
+            log.error("async checkpoint save to %s failed: %r", directory, e)
+            with _async_lock:
+                _async_errors.append(e)
+
+    t = threading.Thread(target=run, daemon=True, name="dl4j-ckpt-save")
+    with _async_lock:
+        _async_threads[:] = [x for x in _async_threads if x.is_alive()]
+        _async_threads.append(t)
     t.start()
     return t
+
+
+def join_async(timeout: Optional[float] = None) -> None:
+    """Wait for every outstanding async save; re-raise the first failure."""
+    with _async_lock:
+        threads = list(_async_threads)
+    for t in threads:
+        t.join(timeout)
+    with _async_lock:
+        _async_threads[:] = [x for x in _async_threads if x.is_alive()]
+    _raise_pending_async_error()
 
 
 def load(directory: str, like_params=None, like_updater=None
@@ -132,6 +181,25 @@ def load(directory: str, like_params=None, like_updater=None
             node = node.setdefault(p, {})
         node[parts[-1]] = v
     return nested.get("params", nested), nested.get("updater"), meta
+
+
+def load_resilient(directory: str, like_params=None, like_updater=None
+                   ) -> Optional[Tuple[Any, Any, Dict[str, Any]]]:
+    """Newest VALID checkpoint among '<dir>' then '<dir>.bak', or None.
+
+    `load()` only consults the .bak when the main dir is missing; this
+    also survives a main dir that exists but is corrupt (torn npz,
+    truncated meta.json) — auto-resume must never crash on a bad
+    checkpoint, just fall back or start fresh."""
+    for cand in (directory, directory + ".bak"):
+        if not os.path.isdir(cand):
+            continue
+        try:
+            return load(cand, like_params, like_updater)
+        except Exception as e:  # noqa: BLE001 — corrupt entry, try fallback
+            log.warning("checkpoint %s unreadable (%r); trying fallback",
+                        cand, e)
+    return None
 
 
 def load_conf(directory: str):

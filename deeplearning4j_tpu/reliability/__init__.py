@@ -1,0 +1,47 @@
+"""Resilience layer (ISSUE 5): deterministic fault injection, circuit
+breaking, and the exception vocabulary shared by the hardened serving
+and training paths.  Stdlib-only — importable before (and without) jax.
+"""
+
+from deeplearning4j_tpu.reliability.circuit import CircuitBreaker
+from deeplearning4j_tpu.reliability.faults import (
+    FaultInjected,
+    FaultPlanError,
+    FaultRegistry,
+    REGISTRY,
+    arm,
+    disarm,
+    fire,
+    hits,
+    install_env_plan,
+    reset,
+    stats,
+)
+
+
+class DeadlineExceeded(TimeoutError):
+    """A request's `deadline_ms` elapsed before it produced a result
+    (serving maps this to HTTP 504)."""
+
+
+class TrainingInterrupted(RuntimeError):
+    """`fit()` was interrupted (SIGTERM/preemption) and checkpointed;
+    re-running with the same `checkpoint_dir` resumes where it left off."""
+
+
+__all__ = [
+    "CircuitBreaker",
+    "DeadlineExceeded",
+    "FaultInjected",
+    "FaultPlanError",
+    "FaultRegistry",
+    "REGISTRY",
+    "TrainingInterrupted",
+    "arm",
+    "disarm",
+    "fire",
+    "hits",
+    "install_env_plan",
+    "reset",
+    "stats",
+]

@@ -1,0 +1,240 @@
+"""Deterministic fault-injection harness (ISSUE 5 tentpole).
+
+Production failures — a wedged compile, a full disk under the compile
+cache, a preempted prefetch worker, a dying dispatcher — are rare and
+unreproducible exactly when a test needs them.  This registry gives the
+codebase NAMED injection points that product code traverses on its hot
+paths and that tests (or an operator, via ``DL4J_FAULT_PLAN``) can arm
+to raise, delay, or corrupt on the Nth traversal, deterministically.
+
+Injection points wired into the codebase:
+
+  ``prefetch.worker``     per batch produced by `PrefetchIterator`'s
+                          background thread (datasets/iterator.py)
+  ``persist.read``        disk-cache entry read (optimize/persist.py)
+  ``persist.write``       disk-cache entry write; ``corrupt`` flips
+                          payload bytes so checksum validation trips
+  ``compile``             fresh trace+compile in the shared
+                          `CompiledProgramCache` (optimize/step_cache.py)
+  ``dispatcher.execute``  per coalesced batch in the serving gateway's
+                          dispatcher (serving/batcher.py)
+  ``checkpoint.save``     atomic checkpoint write (parallel/checkpoint.py)
+
+The registry is generic — tests may `fire()` arbitrary point names of
+their own.  With nothing armed, `fire()` is a counter bump under a lock:
+cheap enough for per-batch (not per-row) call sites.
+
+Env hook: ``DL4J_FAULT_PLAN="point=action[:param][@nth][xTIMES],..."``
+  actions: ``raise`` (FaultInjected), ``oserror``, ``ioerror``,
+  ``timeout``, ``delay:SECONDS``, ``corrupt``.
+  ``@nth`` = first traversal that fires (1-based, default 1);
+  ``xTIMES`` = how many consecutive traversals fire (default 1).
+Example: ``DL4J_FAULT_PLAN="dispatcher.execute=raise@3x2,persist.write=oserror"``
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import re
+import threading
+import time
+from typing import Dict, Optional
+
+log = logging.getLogger("deeplearning4j_tpu")
+
+#: monkeypatchable clock sleep used by the ``delay`` action
+_sleep = time.sleep
+
+ENV_VAR = "DL4J_FAULT_PLAN"
+
+_PLAN_RE = re.compile(
+    r"(?P<action>[a-z_]+)"
+    r"(?::(?P<param>[0-9.]+))?"
+    r"(?:@(?P<nth>[0-9]+))?"
+    r"(?:x(?P<times>[0-9]+))?$")
+
+_EXC_TYPES = {
+    "raise": None,  # FaultInjected (resolved below; forward ref)
+    "oserror": OSError,
+    "ioerror": IOError,
+    "timeout": TimeoutError,
+}
+
+
+class FaultInjected(RuntimeError):
+    """Raised by an armed injection point (the default ``raise`` action)."""
+
+
+class FaultPlanError(ValueError):
+    """A ``DL4J_FAULT_PLAN`` / `arm()` spec could not be parsed."""
+
+
+class _Plan:
+    __slots__ = ("point", "action", "nth", "times", "exc", "delay_s",
+                 "fired")
+
+    def __init__(self, point, action, nth, times, exc, delay_s):
+        self.point = point
+        self.action = action
+        self.nth = int(nth)
+        self.times = int(times)
+        self.exc = exc
+        self.delay_s = float(delay_s)
+        self.fired = 0
+
+    def window(self, hit: int) -> bool:
+        """Does traversal number `hit` (1-based) fall in the armed
+        [nth, nth+times) window?"""
+        return self.nth <= hit < self.nth + self.times
+
+    def as_dict(self) -> dict:
+        return {"action": self.action, "nth": self.nth, "times": self.times,
+                "fired": self.fired}
+
+
+def _corrupt_bytes(data: bytes) -> bytes:
+    """Flip the leading bytes — enough to break any magic/checksum while
+    keeping the length (a torn-length corruption is a different bug)."""
+    n = min(64, len(data))
+    return bytes(b ^ 0xFF for b in data[:n]) + data[n:]
+
+
+class FaultRegistry:
+    """Thread-safe registry of armed fault plans + per-point hit counts."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._plans: Dict[str, _Plan] = {}
+        self._hits: Dict[str, int] = {}
+        self._env_installed = False
+
+    # -- arming -------------------------------------------------------------
+    def arm(self, point: str, action: str = "raise", nth: int = 1,
+            times: int = 1, exc=None, delay_s: float = 0.05) -> None:
+        """Arm `point` to fire on its `nth` traversal (1-based) and the
+        `times - 1` traversals after it.
+
+        action: ``raise`` (FaultInjected or `exc`), ``oserror``,
+        ``ioerror``, ``timeout``, ``delay`` (sleep `delay_s`), or
+        ``corrupt`` (mutate the payload passed to `fire(data=...)`).
+        Counting starts from the point's CURRENT hit count, so arming
+        mid-run targets future traversals."""
+        if action in _EXC_TYPES:
+            exc = exc or _EXC_TYPES[action] or FaultInjected
+        elif action not in ("delay", "corrupt"):
+            raise FaultPlanError(f"unknown fault action {action!r}")
+        with self._lock:
+            base = self._hits.get(point, 0)
+            self._plans[point] = _Plan(point, action, base + int(nth),
+                                       times, exc, delay_s)
+
+    def disarm(self, point: Optional[str] = None) -> None:
+        """Disarm one point (or every point with None); hit counters
+        keep counting."""
+        with self._lock:
+            if point is None:
+                self._plans.clear()
+            else:
+                self._plans.pop(point, None)
+
+    def reset(self) -> None:
+        """Disarm everything and zero every hit counter (test teardown)."""
+        with self._lock:
+            self._plans.clear()
+            self._hits.clear()
+            self._env_installed = False
+
+    # -- env hook -----------------------------------------------------------
+    def install_env_plan(self, spec: Optional[str] = None) -> int:
+        """Parse ``DL4J_FAULT_PLAN`` (or an explicit `spec`) and arm each
+        entry; returns the number of plans armed.  Called lazily by the
+        first `fire()`, so simply exporting the variable arms a process."""
+        spec = os.environ.get(ENV_VAR, "") if spec is None else spec
+        with self._lock:
+            self._env_installed = True
+        n = 0
+        for part in (spec or "").split(","):
+            part = part.strip()
+            if not part:
+                continue
+            point, sep, rest = part.partition("=")
+            m = _PLAN_RE.match(rest.strip()) if sep else None
+            if not point or m is None:
+                raise FaultPlanError(
+                    f"bad fault plan entry {part!r} (want "
+                    f"point=action[:param][@nth][xTIMES])")
+            action = m.group("action")
+            kw = {"nth": int(m.group("nth") or 1),
+                  "times": int(m.group("times") or 1)}
+            if action == "delay":
+                kw["delay_s"] = float(m.group("param") or 0.05)
+            self.arm(point.strip(), action, **kw)
+            n += 1
+        if n:
+            log.warning("fault plan armed from %s: %s", ENV_VAR, spec)
+        return n
+
+    # -- the injection point ------------------------------------------------
+    def fire(self, point: str, data=None, **ctx):
+        """Traverse injection point `point`.
+
+        Returns `data` unchanged (the common case), a corrupted copy of
+        it (``corrupt`` plans), or raises/delays per the armed plan.
+        Product code calls this unconditionally; un-armed points only
+        pay a lock + counter bump."""
+        with self._lock:
+            if not self._env_installed:
+                self._lock.release()
+                try:
+                    self.install_env_plan()
+                finally:
+                    self._lock.acquire()
+            hit = self._hits.get(point, 0) + 1
+            self._hits[point] = hit
+            plan = self._plans.get(point)
+            live = plan is not None and plan.window(hit)
+            if live:
+                plan.fired += 1
+        if not live:
+            return data
+        log.warning("fault injected at %s (hit %d, action %s)%s",
+                    point, hit, plan.action,
+                    f" ctx={ctx}" if ctx else "")
+        if plan.action == "delay":
+            _sleep(plan.delay_s)
+            return data
+        if plan.action == "corrupt":
+            if isinstance(data, (bytes, bytearray)):
+                return _corrupt_bytes(bytes(data))
+            # no corruptible payload at this site — fail loudly rather
+            # than silently doing nothing
+            raise FaultInjected(
+                f"corrupt armed at {point} but fire() got no bytes payload")
+        raise plan.exc(f"injected fault at {point} (hit {hit})")
+
+    # -- observability ------------------------------------------------------
+    def hits(self, point: str) -> int:
+        with self._lock:
+            return self._hits.get(point, 0)
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "hits": dict(self._hits),
+                "armed": {p: plan.as_dict()
+                          for p, plan in self._plans.items()},
+            }
+
+
+#: process-wide registry — product code and tests share one instance
+REGISTRY = FaultRegistry()
+
+# module-level conveniences (the public API)
+arm = REGISTRY.arm
+disarm = REGISTRY.disarm
+reset = REGISTRY.reset
+fire = REGISTRY.fire
+hits = REGISTRY.hits
+stats = REGISTRY.stats
+install_env_plan = REGISTRY.install_env_plan

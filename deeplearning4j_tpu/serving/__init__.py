@@ -3,11 +3,15 @@
 Turns many concurrent single-row (or small-batch) predict requests into
 one bucketed device call over the serve-path AOT compile cache
 (`optimize/infer_cache.py`): `MicroBatcher` coalesces, `ModelServer`
-exposes it over HTTP.
+exposes it over HTTP.  Hardened by the resilience layer (ISSUE 5):
+per-request deadlines, a circuit breaker with eager degraded mode,
+health/readiness endpoints, and bounded graceful drain.
 """
 
+from deeplearning4j_tpu.reliability import CircuitBreaker, DeadlineExceeded
 from deeplearning4j_tpu.serving.batcher import (MicroBatcher,
                                                 ServerOverloaded)
-from deeplearning4j_tpu.serving.server import ModelServer
+from deeplearning4j_tpu.serving.server import ModelServer, ServerDraining
 
-__all__ = ["MicroBatcher", "ModelServer", "ServerOverloaded"]
+__all__ = ["CircuitBreaker", "DeadlineExceeded", "MicroBatcher",
+           "ModelServer", "ServerDraining", "ServerOverloaded"]

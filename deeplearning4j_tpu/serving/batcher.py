@@ -22,6 +22,20 @@ rows a direct `net.output()` call would have returned.
 Backpressure: the queue is bounded (`max_pending` requests); beyond it
 `predict()` fails fast with `ServerOverloaded` (HTTP 503 upstream)
 instead of growing memory without bound.
+
+Resilience (ISSUE 5):
+  - per-request `deadline_ms`, enforced at enqueue AND again after
+    coalescing — a request that expires while queued is evicted before
+    the batch is padded/executed and answered `DeadlineExceeded`
+    (HTTP 504 upstream), so dead rows never waste device time;
+  - a `CircuitBreaker` around the cached execute path: after
+    `failure_threshold` consecutive failures the breaker opens and the
+    gateway degrades to the uncached eager forward pass
+    (`network_output`), which shares none of the compile-cache
+    machinery with the primary path; half-open probes re-try the
+    primary and close the breaker on success.  Degraded batches are
+    still row-sliced per request and are numerically identical to an
+    eager `net.output()` call.
 """
 
 from __future__ import annotations
@@ -32,6 +46,8 @@ from collections import deque
 from typing import Deque, Dict, Optional, Tuple
 
 import numpy as np
+
+from deeplearning4j_tpu.reliability import CircuitBreaker, DeadlineExceeded, faults
 
 #: coalescing target when no row bucket is known yet and the caller set
 #: no `max_batch_rows` cap
@@ -48,15 +64,18 @@ class ServerOverloaded(RuntimeError):
 class _Pending:
     """One enqueued request: its rows, completion event, and timing."""
 
-    __slots__ = ("x", "rows", "done", "result", "error", "t_enqueue")
+    __slots__ = ("x", "rows", "done", "result", "error", "t_enqueue",
+                 "deadline")
 
-    def __init__(self, x):
+    def __init__(self, x, deadline_ms: Optional[float] = None):
         self.x = x
         self.rows = int(x.shape[0])
         self.done = threading.Event()
         self.result = None
         self.error: Optional[BaseException] = None
         self.t_enqueue = time.monotonic()
+        self.deadline = (None if deadline_ms is None
+                         else self.t_enqueue + float(deadline_ms) / 1000.0)
 
 
 class MicroBatcher:
@@ -72,16 +91,21 @@ class MicroBatcher:
                     the largest known infer-cache bucket (so a warmed
                     server batches exactly into its warmed program), or
                     `DEFAULT_TARGET_ROWS` when no bucket exists yet.
+    breaker:        `CircuitBreaker` guarding the cached execute path;
+                    pass your own to tune thresholds (tests inject a
+                    fake-clock breaker).
     """
 
     def __init__(self, net, max_delay_ms: float = 3.0,
                  max_pending: int = 1024,
                  max_batch_rows: Optional[int] = None,
-                 auto_start: bool = True):
+                 auto_start: bool = True,
+                 breaker: Optional[CircuitBreaker] = None):
         self.net = net
         self.max_delay_s = float(max_delay_ms) / 1000.0
         self.max_pending = int(max_pending)
         self.max_batch_rows = max_batch_rows
+        self.breaker = breaker if breaker is not None else CircuitBreaker()
         self._auto_start = auto_start
         self._cv = threading.Condition()
         # key = (feature shape beyond axis 0, dtype): only requests that
@@ -97,6 +121,9 @@ class MicroBatcher:
         self._batch_hist: Dict[int, int] = {}   # flushed batch rows -> count
         self._latencies: Deque[float] = deque(maxlen=4096)  # seconds
         self._recent: Deque[Tuple[float, int]] = deque()    # (t_done, rows)
+        self._deadline_misses = 0   # requests evicted past their deadline
+        self._errors = 0            # requests answered with an exception
+        self._degraded_batches = 0  # batches served by the eager fallback
 
     # -- lifecycle ----------------------------------------------------------
     def start(self) -> "MicroBatcher":
@@ -110,7 +137,7 @@ class MicroBatcher:
             self._thread.start()
         return self
 
-    def stop(self) -> None:
+    def stop(self, timeout: float = 30.0) -> None:
         """Stop the dispatcher; queued requests are drained (served)
         before the thread exits."""
         with self._cv:
@@ -118,20 +145,29 @@ class MicroBatcher:
             thread, self._thread = self._thread, None
             self._cv.notify_all()
         if thread is not None:
-            thread.join(timeout=30.0)
+            thread.join(timeout=timeout)
 
     # -- request side (any thread) ------------------------------------------
-    def predict(self, x, timeout: Optional[float] = None) -> np.ndarray:
+    def predict(self, x, timeout: Optional[float] = None,
+                deadline_ms: Optional[float] = None) -> np.ndarray:
         """Enqueue `x` ([rows, ...features]) and block until its output
-        activations come back from a coalesced device call.  Raises
-        `ServerOverloaded` when `max_pending` requests are already
-        queued, `TimeoutError` past `timeout` seconds."""
+        activations come back from a coalesced device call.
+
+        Raises `ServerOverloaded` when `max_pending` requests are
+        already queued, `DeadlineExceeded` when `deadline_ms` elapses
+        before a result exists (checked at enqueue and again after
+        coalescing), and `TimeoutError` past `timeout` seconds."""
         x = np.asarray(x)
         if x.ndim < 2:
             raise ValueError(
                 f"predict expects batched input [rows, ...features]; "
                 f"got shape {x.shape}")
-        req = _Pending(x)
+        if deadline_ms is not None and float(deadline_ms) <= 0.0:
+            with self._cv:
+                self._deadline_misses += 1
+            raise DeadlineExceeded(
+                f"deadline_ms={deadline_ms} already expired at enqueue")
+        req = _Pending(x, deadline_ms)
         key = (x.shape[1:], str(x.dtype))
         with self._cv:
             if self._pending >= self.max_pending:
@@ -176,9 +212,37 @@ class MicroBatcher:
                 best_key, best_t = key, q[0].t_enqueue
         return best_key
 
+    def _evict_expired_locked(self, now: float) -> None:
+        """Answer every queued request whose deadline has passed with
+        `DeadlineExceeded` — before it is coalesced, padded, or allowed
+        to hold a batch open.  Caller holds `_cv`."""
+        for q in self._queues.values():
+            expired = [r for r in q
+                       if r.deadline is not None and now >= r.deadline]
+            for r in expired:
+                q.remove(r)
+                self._pending -= 1
+                self._deadline_misses += 1
+                self._errors += 1
+                r.error = DeadlineExceeded(
+                    f"deadline exceeded after "
+                    f"{(now - r.t_enqueue) * 1e3:.1f}ms in queue")
+                r.done.set()
+
+    def _earliest_deadline_locked(self) -> Optional[float]:
+        best = None
+        for q in self._queues.values():
+            for r in q:
+                if r.deadline is not None and (best is None
+                                               or r.deadline < best):
+                    best = r.deadline
+        return best
+
     def _dispatch_loop(self) -> None:
         while True:
             with self._cv:
+                now = time.monotonic()
+                self._evict_expired_locked(now)
                 key = self._oldest_key()
                 if key is None:
                     if self._stop:
@@ -188,12 +252,15 @@ class MicroBatcher:
                 q = self._queues[key]
                 target = self._target_rows()
                 queued_rows = sum(r.rows for r in q)
-                deadline = q[0].t_enqueue + self.max_delay_s
-                now = time.monotonic()
+                flush_at = q[0].t_enqueue + self.max_delay_s
                 # stopping: drain immediately rather than wait out SLOs
-                if (queued_rows < target and now < deadline
+                if (queued_rows < target and now < flush_at
                         and not self._stop):
-                    self._cv.wait(timeout=deadline - now)
+                    # wake early if any queued request's deadline lands
+                    # before the flush, so eviction is prompt
+                    edl = self._earliest_deadline_locked()
+                    wake_at = flush_at if edl is None else min(flush_at, edl)
+                    self._cv.wait(timeout=max(wake_at - now, 1e-4))
                     continue
                 batch = [q.popleft()]
                 rows = batch[0].rows
@@ -204,14 +271,42 @@ class MicroBatcher:
                 self._pending -= len(batch)
             self._execute(batch)
 
+    # -- execution paths -----------------------------------------------------
+    def _primary_output(self, xb: np.ndarray) -> np.ndarray:
+        """The cached path: infer-cache bucketed AOT program (or a fresh
+        compile on a miss).  Guarded by the circuit breaker."""
+        faults.fire("dispatcher.execute", rows=int(xb.shape[0]))
+        return np.asarray(self.net.output(xb))
+
+    def _degraded_output(self, xb: np.ndarray) -> np.ndarray:
+        """The fallback: uncached eager forward pass, sharing none of
+        the compile/persist machinery with the primary path.  Row
+        independence still holds, so slicing stays bitwise-correct."""
+        from deeplearning4j_tpu.nn.multilayer import network_output
+        return np.asarray(network_output(self.net.conf, self.net.params, xb))
+
     def _execute(self, batch) -> None:
         xs = [r.x for r in batch]
         xb = xs[0] if len(xs) == 1 else np.concatenate(xs, axis=0)
-        try:
-            out = np.asarray(self.net.output(xb))
-            err = None
-        except BaseException as e:  # noqa: BLE001 — delivered per request
-            out, err = None, e
+        out, err, degraded = None, None, False
+        if self.breaker.allow():
+            try:
+                out = self._primary_output(xb)
+                self.breaker.record_success()
+            except BaseException as e:  # noqa: BLE001 — degrade, then report
+                self.breaker.record_failure()
+                err = e
+        else:
+            err = RuntimeError("circuit breaker open")
+        if out is None:
+            try:
+                out = self._degraded_output(xb)
+                degraded, err = True, None
+            except BaseException as e:  # noqa: BLE001 — delivered per request
+                # both paths failed (e.g. malformed input): the PRIMARY
+                # error is what callers should see when we have one
+                err = err if err is not None else e
+                out = None
         t_done = time.monotonic()
         offset = 0
         for r in batch:
@@ -231,6 +326,10 @@ class MicroBatcher:
                 self._recent.popleft()
             for r in batch:
                 self._latencies.append(t_done - r.t_enqueue)
+            if degraded:
+                self._degraded_batches += 1
+            if err is not None:
+                self._errors += len(batch)
 
     # -- observability -------------------------------------------------------
     @staticmethod
@@ -243,8 +342,10 @@ class MicroBatcher:
 
     def stats(self) -> dict:
         """Gateway counters for `/v1/stats`: queue depth, batch-size
-        histogram, latency percentiles, rows/s, and the fresh-compile
-        count (infer-cache misses — a warmed server serves with 0)."""
+        histogram, latency percentiles, rows/s, the fresh-compile count
+        (infer-cache misses — a warmed server serves with 0), plus the
+        resilience block (deadline misses, errors, breaker state,
+        `degraded` = currently serving on the eager fallback)."""
         with self._cv:
             lat = sorted(self._latencies)
             now = time.monotonic()
@@ -254,7 +355,11 @@ class MicroBatcher:
             depth = self._pending
             reqs, rows = self._reqs_done, self._rows_done
             hist = {str(k): v for k, v in sorted(self._batch_hist.items())}
+            deadline_misses = self._deadline_misses
+            errors = self._errors
+            degraded_batches = self._degraded_batches
         cache = self.net.infer_cache.stats
+        breaker = self.breaker.stats()
         return {
             "queue_depth": depth,
             "max_pending": self.max_pending,
@@ -271,4 +376,9 @@ class MicroBatcher:
             },
             "fresh_compiles": cache.misses,
             "cache": cache.as_dict(),
+            "deadline_misses": deadline_misses,
+            "errors": errors,
+            "degraded_batches": degraded_batches,
+            "degraded": breaker["state"] != CircuitBreaker.CLOSED,
+            "breaker": breaker,
         }

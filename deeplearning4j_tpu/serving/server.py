@@ -1,30 +1,51 @@
 """HTTP front of the micro-batching gateway (sibling of `ui/server.py`).
 
-  POST /v1/predict   {"features": [[...], ...]} -> {"output": [...], "rows": n}
-                     (503 + {"error": ...} when the gateway queue is full,
-                     504 when a request waits out `request_timeout_s`)
+  POST /v1/predict   {"features": [[...], ...], "deadline_ms": 250?}
+                     -> {"output": [...], "rows": n}
+                     (503 + {"error": ...} when the gateway queue is full
+                     or the server is draining, 504 when a request waits
+                     out `request_timeout_s` or its own `deadline_ms`)
   GET  /v1/stats     gateway counters (queue depth, batch-size histogram,
-                     p50/p95/p99 latency, rows/s, fresh-compile count) plus
+                     p50/p95/p99 latency, rows/s, fresh-compile count,
+                     deadline misses, breaker state, `degraded` flag) plus
                      the infer cache's stats block (`disk_hits` etc.), so a
                      warmed server is observable in one curl.
+  GET  /healthz      liveness: 200 while the process can answer at all.
+  GET  /readyz       readiness: 200 only once `start()` ran (post-warmup)
+                     and the server is not draining — what a load
+                     balancer keys traffic on.
 
 Handler threads (stdlib `ThreadingHTTPServer`, one per connection) only
 parse JSON and park on the batcher — every device call is made by the
 single dispatcher thread, which is what turns N concurrent clients into
 one bucketed program execution.
+
+Graceful drain (SIGTERM semantics, ISSUE 5): `drain()` flips the server
+to draining (readyz → 503, new predicts → 503), stops the accept loop,
+waits for in-flight handlers to finish, then stops the batcher — which
+itself serves every queued request before its dispatcher exits.  Every
+request accepted before the drain gets a real response; the whole
+sequence is bounded by `drain_timeout_s`.  `stop()` is `drain()` — the
+abrupt path no longer exists.
 """
 
 from __future__ import annotations
 
 import json
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 from urllib.parse import urlparse
 
 import numpy as np
 
+from deeplearning4j_tpu.reliability import CircuitBreaker, DeadlineExceeded
 from deeplearning4j_tpu.serving.batcher import MicroBatcher, ServerOverloaded
+
+
+class ServerDraining(RuntimeError):
+    """The server is shutting down and no longer accepts work (503)."""
 
 
 class _ServeHandler(BaseHTTPRequestHandler):
@@ -43,8 +64,17 @@ class _ServeHandler(BaseHTTPRequestHandler):
         return json.loads(self.rfile.read(n) or b"{}")
 
     def do_GET(self):  # noqa: N802
-        if urlparse(self.path).path == "/v1/stats":
+        path = urlparse(self.path).path
+        if path == "/v1/stats":
             self._send(self.model_server.stats())
+        elif path == "/healthz":
+            self._send({"ok": True})
+        elif path == "/readyz":
+            ms = self.model_server
+            if ms.is_ready():
+                self._send({"ready": True})
+            else:
+                self._send({"ready": False, "draining": ms.draining}, 503)
         else:
             self._send({"error": "not found"}, 404)
 
@@ -52,25 +82,42 @@ class _ServeHandler(BaseHTTPRequestHandler):
         if urlparse(self.path).path != "/v1/predict":
             self._send({"error": "not found"}, 404)
             return
+        ms = self.model_server
+        if not ms.enter_request():
+            self._send({"error": "draining: server is shutting down"}, 503)
+            return
         try:
-            body = self._body()
-            feats = np.asarray(body["features"],
-                               dtype=body.get("dtype", "float32"))
-        except (KeyError, ValueError, json.JSONDecodeError) as e:
-            self._send({"error": f"bad request: {e}"}, 400)
-            return
-        if feats.ndim == 1:  # single example: make it a 1-row batch
-            feats = feats[None, :]
-        try:
-            out = self.model_server.predict(feats)
-        except ServerOverloaded as e:
-            self._send({"error": f"overloaded: {e}"}, 503)
-            return
-        except TimeoutError as e:
-            self._send({"error": f"timed out: {e}"}, 504)
-            return
-        self._send({"output": np.asarray(out).tolist(),
-                    "rows": int(feats.shape[0])})
+            try:
+                body = self._body()
+                feats = np.asarray(body["features"],
+                                   dtype=body.get("dtype", "float32"))
+                deadline_ms = body.get("deadline_ms")
+                if deadline_ms is not None:
+                    deadline_ms = float(deadline_ms)
+            except (KeyError, ValueError, TypeError,
+                    json.JSONDecodeError) as e:
+                self._send({"error": f"bad request: {e}"}, 400)
+                return
+            if feats.ndim == 1:  # single example: make it a 1-row batch
+                feats = feats[None, :]
+            try:
+                out = ms.predict(feats, deadline_ms=deadline_ms)
+            except ServerOverloaded as e:
+                self._send({"error": f"overloaded: {e}"}, 503)
+                return
+            except ServerDraining as e:
+                self._send({"error": f"draining: {e}"}, 503)
+                return
+            except DeadlineExceeded as e:
+                self._send({"error": f"deadline exceeded: {e}"}, 504)
+                return
+            except TimeoutError as e:
+                self._send({"error": f"timed out: {e}"}, 504)
+                return
+            self._send({"output": np.asarray(out).tolist(),
+                        "rows": int(feats.shape[0])})
+        finally:
+            ms.exit_request()
 
     def log_message(self, *args):  # quiet
         pass
@@ -82,49 +129,133 @@ class ModelServer:
     batching=False bypasses the gateway (each handler thread calls
     `net.output` directly) — the control arm of `bench_serve`, and an
     escape hatch for debugging.
+
+    default_deadline_ms applies to requests that carry no `deadline_ms`
+    of their own (None = unbounded queue wait up to `request_timeout_s`).
     """
 
     def __init__(self, net, host: str = "127.0.0.1", port: int = 0,
                  max_delay_ms: float = 3.0, max_pending: int = 1024,
                  max_batch_rows: Optional[int] = None,
                  batching: bool = True,
-                 request_timeout_s: float = 30.0):
+                 request_timeout_s: float = 30.0,
+                 drain_timeout_s: float = 10.0,
+                 default_deadline_ms: Optional[float] = None,
+                 breaker: Optional[CircuitBreaker] = None):
         self.net = net
         self.batching = bool(batching)
         self.request_timeout_s = float(request_timeout_s)
+        self.drain_timeout_s = float(drain_timeout_s)
+        self.default_deadline_ms = default_deadline_ms
         self.batcher = MicroBatcher(
             net, max_delay_ms=max_delay_ms, max_pending=max_pending,
-            max_batch_rows=max_batch_rows, auto_start=False)
+            max_batch_rows=max_batch_rows, auto_start=False,
+            breaker=breaker)
         handler = type("Handler", (_ServeHandler,), {"model_server": self})
         self.server = ThreadingHTTPServer((host, port), handler)
         self.port = self.server.server_address[1]
         self._thread: Optional[threading.Thread] = None
+        self._state_lock = threading.Lock()
+        self._ready = False
+        self._draining = False
+        self._drained = False
+        self._inflight = 0
+        self._stop_requested = threading.Event()
 
-    def predict(self, feats: np.ndarray) -> np.ndarray:
+    # -- request bookkeeping (handler threads) -------------------------------
+    @property
+    def draining(self) -> bool:
+        with self._state_lock:
+            return self._draining
+
+    def is_ready(self) -> bool:
+        with self._state_lock:
+            return self._ready and not self._draining
+
+    def enter_request(self) -> bool:
+        """Admit a predict request: False once draining (handler answers
+        503 instead of enqueueing work that would race the shutdown)."""
+        with self._state_lock:
+            if self._draining:
+                return False
+            self._inflight += 1
+            return True
+
+    def exit_request(self) -> None:
+        with self._state_lock:
+            self._inflight -= 1
+
+    def predict(self, feats: np.ndarray,
+                deadline_ms: Optional[float] = None) -> np.ndarray:
+        if self.draining:
+            raise ServerDraining("server is draining")
+        if deadline_ms is None:
+            deadline_ms = self.default_deadline_ms
         if self.batching:
             return self.batcher.predict(feats,
-                                        timeout=self.request_timeout_s)
+                                        timeout=self.request_timeout_s,
+                                        deadline_ms=deadline_ms)
         return np.asarray(self.net.output(feats))
 
     def stats(self) -> dict:
         out = self.batcher.stats()
         out["batching"] = self.batching
+        with self._state_lock:
+            out["ready"] = self._ready and not self._draining
+            out["draining"] = self._draining
+            out["inflight"] = self._inflight
+        out["drain_timeout_s"] = self.drain_timeout_s
         store = self.net.infer_cache.persist
         if store is not None:
             out["compile_cache_dir"] = store.directory
         return out
 
+    # -- lifecycle ------------------------------------------------------------
     def start(self) -> "ModelServer":
         self.batcher.start()
         self._thread = threading.Thread(target=self.server.serve_forever,
                                         daemon=True)
         self._thread.start()
+        with self._state_lock:
+            self._ready = True  # callers warm the compile cache before start
         return self
 
-    def stop(self) -> None:
-        self.server.shutdown()
+    def request_stop(self) -> None:
+        """Signal-handler-safe stop request: just sets an event.  The
+        thread parked in `wait_for_stop()` (e.g. the CLI main thread)
+        performs the actual drain."""
+        self._stop_requested.set()
+
+    def wait_for_stop(self, timeout: Optional[float] = None) -> bool:
+        return self._stop_requested.wait(timeout)
+
+    def drain(self, timeout_s: Optional[float] = None) -> None:
+        """Graceful shutdown: stop admitting, stop accepting, wait out
+        in-flight handlers, then drain the batcher (its queued requests
+        are served, not dropped).  Bounded by `timeout_s` (default
+        `drain_timeout_s`); idempotent."""
+        timeout = self.drain_timeout_s if timeout_s is None else float(
+            timeout_s)
+        with self._state_lock:
+            if self._drained:
+                return
+            self._drained = True
+            self._draining = True
+        self._stop_requested.set()
+        deadline = time.monotonic() + timeout
+        if self._thread is not None:
+            self.server.shutdown()  # accept loop exits; sockets stay open
+        while time.monotonic() < deadline:
+            with self._state_lock:
+                if self._inflight == 0:
+                    break
+            time.sleep(0.005)
+        # batcher drain-on-stop serves whatever the handlers enqueued
+        self.batcher.stop(timeout=max(deadline - time.monotonic(), 1.0))
         self.server.server_close()
-        self.batcher.stop()
+
+    def stop(self) -> None:
+        self.drain()
 
     @property
     def url(self) -> str:

@@ -246,3 +246,77 @@ def test_gunzip_file_idempotent(tmp_path):
     out = gunzip_file(str(gz))
     assert open(out, "rb").read() == raw
     assert gunzip_file(str(gz)) == out  # second call reuses
+
+
+# -- retry backoff (ISSUE 5 satellite): jittered exponential, partials
+# cleaned per attempt, monkeypatchable sleep -------------------------------
+
+def _flaky_opener(payload: bytes, fail_first: int):
+    """urlopen stand-in that errors `fail_first` times, then serves."""
+    calls = {"n": 0}
+
+    class _Resp(io.BytesIO):
+        def __enter__(self):
+            return self
+
+        def __exit__(self, *a):
+            self.close()
+
+    def opener(url, timeout=None):
+        calls["n"] += 1
+        if calls["n"] <= fail_first:
+            raise OSError(f"mirror down (attempt {calls['n']})")
+        return _Resp(payload)
+
+    return opener, calls
+
+
+def test_download_retries_with_jittered_exponential_backoff(
+        tmp_path, monkeypatch):
+    from deeplearning4j_tpu.datasets import fetch
+
+    payload = b"eventually consistent mirror"
+    opener, calls = _flaky_opener(payload, fail_first=2)
+    slept = []
+    monkeypatch.setattr(fetch, "_sleep", slept.append)
+    dest = str(tmp_path / "d.bin")
+    out = download_file("http://mirror/d.bin", dest,
+                        sha256=hashlib.sha256(payload).hexdigest(),
+                        retries=4, opener=opener)
+    assert out == dest and open(dest, "rb").read() == payload
+    assert calls["n"] == 3
+    # one backoff per failed attempt, inside the full-jitter envelope
+    # (0, min(cap, base * 2**(n-1))]
+    assert len(slept) == 2
+    for n, delay in enumerate(slept, start=1):
+        ceiling = min(fetch.BACKOFF_CAP_S,
+                      fetch.BACKOFF_BASE_S * 2.0 ** (n - 1))
+        assert 0.0 < delay <= ceiling, (n, delay, ceiling)
+    assert not os.path.exists(dest + ".part")  # partials cleaned per attempt
+
+
+def test_download_all_attempts_fail_leaves_no_partial(tmp_path, monkeypatch):
+    from deeplearning4j_tpu.datasets import fetch
+
+    opener, calls = _flaky_opener(b"", fail_first=99)
+    slept = []
+    monkeypatch.setattr(fetch, "_sleep", slept.append)
+    dest = str(tmp_path / "never.bin")
+    with pytest.raises(IOError):
+        download_file("http://mirror/never.bin", dest, retries=3,
+                      opener=opener)
+    assert calls["n"] == 3
+    assert len(slept) == 2  # no sleep after the terminal attempt
+    assert not os.path.exists(dest) and not os.path.exists(dest + ".part")
+
+
+def test_backoff_seconds_envelope_and_cap():
+    from deeplearning4j_tpu.datasets.fetch import (BACKOFF_BASE_S,
+                                                   BACKOFF_CAP_S,
+                                                   backoff_seconds)
+
+    assert backoff_seconds(1, rng=lambda: 1.0) == BACKOFF_BASE_S
+    assert backoff_seconds(3, rng=lambda: 1.0) == BACKOFF_BASE_S * 4
+    assert backoff_seconds(50, rng=lambda: 1.0) == BACKOFF_CAP_S  # capped
+    assert backoff_seconds(4, rng=lambda: 0.0) > 0.0  # jitter floor > 0
+    assert backoff_seconds(2, rng=lambda: 0.5) == pytest.approx(0.5)

@@ -1,0 +1,668 @@
+"""Chaos suite (ISSUE 5): the fault-injection harness itself, and the
+resilience behaviors it drives — circuit breaker open/recover with
+degraded-but-correct serving, per-request deadlines (enqueue + post-
+coalesce eviction), disk faults downgraded to cache misses, async
+checkpoint error capture, prefetch worker-crash propagation, graceful
+SIGTERM drain (in-process and via the real CLI subprocess), and
+kill-and-resume bit-for-bit training.  Everything here is CPU-only and
+deliberately small/fast."""
+
+import json
+import os
+import shutil
+import signal
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.models.zoo import mlp
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork, network_output
+from deeplearning4j_tpu.parallel import checkpoint
+from deeplearning4j_tpu.reliability import (CircuitBreaker, DeadlineExceeded,
+                                            TrainingInterrupted, faults)
+from deeplearning4j_tpu.reliability.faults import (FaultInjected,
+                                                   FaultPlanError)
+from deeplearning4j_tpu.serving import MicroBatcher, ServerDraining
+
+N_IN, N_OUT = 6, 3
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.reset()
+    yield
+    faults.reset()
+
+
+def _net(seed=0):
+    return MultiLayerNetwork(mlp(n_in=N_IN, hidden=[8], n_out=N_OUT,
+                                 lr=0.05), seed=seed).init()
+
+
+def _x(rows, seed):
+    rng = np.random.RandomState(seed)
+    return rng.randn(rows, N_IN).astype(np.float32)
+
+
+def _http(url, body=None, timeout=30):
+    req = urllib.request.Request(
+        url, data=None if body is None else json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"},
+        method="GET" if body is None else "POST")
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return r.status, json.loads(r.read())
+
+
+# -- fault registry ----------------------------------------------------------
+
+def test_fault_window_nth_times():
+    faults.arm("demo.point", "raise", nth=2, times=2)
+    faults.fire("demo.point")  # hit 1: before the window
+    for _ in range(2):  # hits 2 and 3: inside [2, 4)
+        with pytest.raises(FaultInjected):
+            faults.fire("demo.point")
+    faults.fire("demo.point")  # hit 4: past the window
+    assert faults.hits("demo.point") == 4
+    assert faults.stats()["armed"]["demo.point"]["fired"] == 2
+
+
+def test_fault_arm_counts_from_current_hits():
+    for _ in range(3):
+        faults.fire("demo.mid")
+    faults.arm("demo.mid", "raise")  # nth=1 relative to NOW -> hit 4
+    with pytest.raises(FaultInjected):
+        faults.fire("demo.mid")
+
+
+def test_fault_actions_map_to_exception_types():
+    faults.arm("demo.os", "oserror")
+    with pytest.raises(OSError):
+        faults.fire("demo.os")
+    faults.arm("demo.to", "timeout")
+    with pytest.raises(TimeoutError):
+        faults.fire("demo.to")
+    with pytest.raises(FaultPlanError):
+        faults.arm("demo.bad", "explode")
+
+
+def test_fault_corrupt_mutates_payload_and_rejects_payloadless_sites():
+    faults.arm("demo.c", "corrupt", times=2)
+    data = bytes(range(200))
+    out = faults.fire("demo.c", data=data)
+    assert out != data and len(out) == len(data)
+    assert out[:64] == bytes(b ^ 0xFF for b in data[:64])
+    assert out[64:] == data[64:]
+    with pytest.raises(FaultInjected):  # corrupt armed, no bytes to corrupt
+        faults.fire("demo.c")
+
+
+def test_fault_delay_action_sleeps(monkeypatch):
+    from deeplearning4j_tpu.reliability import faults as faults_mod
+
+    slept = []
+    monkeypatch.setattr(faults_mod, "_sleep", slept.append)
+    faults.arm("demo.d", "delay", delay_s=0.25)
+    assert faults.fire("demo.d", data="x") == "x"
+    assert slept == [0.25]
+
+
+def test_fault_env_plan_parsing_and_lazy_install(monkeypatch):
+    n = faults.install_env_plan(
+        "a.b=raise@3x2, c.d=oserror, e.f=delay:0.01")
+    assert n == 3
+    armed = faults.stats()["armed"]
+    assert armed["a.b"] == {"action": "raise", "nth": 3, "times": 2,
+                            "fired": 0}
+    assert armed["c.d"]["action"] == "oserror"
+    with pytest.raises(FaultPlanError):
+        faults.install_env_plan("no_equals_sign")
+    faults.reset()
+    # exporting the variable arms the process on the first fire()
+    monkeypatch.setenv("DL4J_FAULT_PLAN", "env.pt=raise@2")
+    faults.fire("env.pt")
+    with pytest.raises(FaultInjected):
+        faults.fire("env.pt")
+
+
+# -- circuit breaker ---------------------------------------------------------
+
+def test_breaker_state_machine_with_fake_clock():
+    now = [0.0]
+    br = CircuitBreaker(failure_threshold=2, reset_timeout_s=5.0,
+                        probe_prob=1.0, clock=lambda: now[0])
+    assert br.allow() and br.state == CircuitBreaker.CLOSED
+    br.record_failure()
+    assert br.state == CircuitBreaker.CLOSED  # 1 < threshold
+    br.record_failure()
+    assert br.state == CircuitBreaker.OPEN
+    assert not br.allow()
+    now[0] = 5.1  # cooldown elapsed -> half-open, probe_prob=1 probes
+    assert br.state == CircuitBreaker.HALF_OPEN
+    assert br.allow()
+    br.record_failure()  # failed probe: straight back to OPEN
+    assert br.state == CircuitBreaker.OPEN and not br.allow()
+    now[0] = 10.2
+    assert br.allow()
+    br.record_success()
+    assert br.state == CircuitBreaker.CLOSED and br.allow()
+    st = br.stats()
+    assert st["opens"] == 2 and st["probes"] == 2
+
+
+def test_breaker_success_resets_consecutive_failures():
+    br = CircuitBreaker(failure_threshold=3)
+    for _ in range(5):  # failures interleaved with successes never open
+        br.record_failure()
+        br.record_failure()
+        br.record_success()
+    assert br.state == CircuitBreaker.CLOSED
+
+
+# -- persist: disk faults are cache misses, corruption self-heals ------------
+
+def test_persist_io_errors_downgrade_to_miss_with_one_warning(
+        tmp_path, caplog):
+    import logging
+
+    net = _net()
+    net.set_compile_cache(str(tmp_path / "cc"))
+    faults.arm("persist.write", "oserror", times=10)
+    with caplog.at_level(logging.WARNING, logger="deeplearning4j_tpu"):
+        net.warmup([4, 8])  # both stores fail on "disk"; warmup succeeds
+    out = np.asarray(net.output(_x(3, seed=1)))
+    assert out.shape == (3, N_OUT)
+    store = net.infer_cache.persist
+    assert store.io_errors == 2
+    assert net.infer_cache.stats.io_errors == 2
+    assert "io_errors" in net.infer_cache.stats.as_dict()
+    warns = [r for r in caplog.records if "treating as a cache miss" in
+             r.getMessage()]
+    assert len(warns) == 1  # warned ONCE, counted twice
+    assert len(store) == 0  # nothing persisted
+
+
+def test_persist_read_fault_is_a_miss_not_a_crash(tmp_path):
+    cache = str(tmp_path / "cc")
+    conf = mlp(n_in=N_IN, hidden=[8], n_out=N_OUT, lr=0.05)
+    warm = MultiLayerNetwork(conf, seed=0).init()
+    warm.set_compile_cache(cache)
+    warm.warmup([4])
+    net = MultiLayerNetwork(conf, seed=0).init()
+    net.set_compile_cache(cache)
+    faults.arm("persist.read", "oserror")
+    net.warmup([4])  # read fails -> counted miss -> fresh compile
+    assert net.infer_cache.persist.io_errors == 1
+    assert net.infer_cache.stats.misses == 1
+    assert net.infer_cache.stats.disk_hits == 0
+
+
+def test_persist_corrupt_write_evicted_then_rewritten(tmp_path):
+    cache = str(tmp_path / "cc")
+    conf = mlp(n_in=N_IN, hidden=[8], n_out=N_OUT, lr=0.05)
+    n1 = MultiLayerNetwork(conf, seed=0).init()
+    n1.set_compile_cache(cache)
+    faults.arm("persist.write", "corrupt")
+    n1.warmup([4])  # persists a torn entry (checksum/magic broken)
+    assert len(n1.infer_cache.persist) == 1
+
+    n2 = MultiLayerNetwork(conf, seed=0).init()
+    n2.set_compile_cache(cache)
+    n2.warmup([4])  # bad entry evicted, recompiled, rewritten clean
+    assert n2.infer_cache.persist.corrupt_evicted == 1
+    assert n2.infer_cache.stats.misses == 1
+
+    n3 = MultiLayerNetwork(conf, seed=0).init()
+    n3.set_compile_cache(cache)
+    n3.warmup([4])  # the rewrite restored durability
+    assert n3.infer_cache.stats.disk_hits == 1
+    np.testing.assert_array_equal(np.asarray(n1.output(_x(4, seed=2))),
+                                  np.asarray(n3.output(_x(4, seed=2))))
+
+
+# -- checkpoint: async error capture, resilient load -------------------------
+
+def test_save_async_failure_surfaces_at_join(tmp_path):
+    params = {"w": np.ones((2, 2), np.float32)}
+    faults.arm("checkpoint.save", "oserror")
+    checkpoint.save_async(str(tmp_path / "ck"), params)
+    with pytest.raises(OSError):
+        checkpoint.join_async(timeout=30.0)
+    # the failure was consumed; the next save round-trips
+    checkpoint.save_async(str(tmp_path / "ck"), params)
+    checkpoint.join_async(timeout=30.0)
+    loaded, _, _ = checkpoint.load(str(tmp_path / "ck"))
+    np.testing.assert_array_equal(loaded["w"], params["w"])
+
+
+def test_save_async_failure_surfaces_at_next_save(tmp_path):
+    params = {"w": np.zeros((2,), np.float32)}
+    faults.arm("checkpoint.save", "oserror")
+    t = checkpoint.save_async(str(tmp_path / "ck"), params)
+    t.join(30.0)
+    with pytest.raises(OSError):
+        checkpoint.save_async(str(tmp_path / "ck2"), params)
+    checkpoint.join_async(timeout=30.0)
+
+
+def test_load_resilient_falls_back_past_corrupt_checkpoint(tmp_path):
+    d = str(tmp_path / "ck")
+    params = {"w": np.arange(4, dtype=np.float32)}
+    checkpoint.save(d, params, step=7)
+    shutil.copytree(d, d + ".bak")
+    with open(os.path.join(d, "arrays.npz"), "wb") as f:
+        f.write(b"torn")  # main checkpoint corrupt; .bak intact
+    got = checkpoint.load_resilient(d, like_params=params)
+    assert got is not None
+    loaded, _, meta = got
+    np.testing.assert_array_equal(np.asarray(loaded["w"]), params["w"])
+    assert meta["step"] == 7
+    assert checkpoint.load_resilient(str(tmp_path / "absent")) is None
+
+
+# -- prefetch: a crashed worker surfaces exactly once ------------------------
+
+def test_prefetch_worker_fault_propagates_exactly_once():
+    from deeplearning4j_tpu.datasets.iterator import PrefetchIterator
+
+    items = [(_x(2, seed=i), _x(2, seed=i + 50)) for i in range(6)]
+    faults.arm("prefetch.worker", nth=4)
+    it = PrefetchIterator(items, to_device=False)
+    it.start()
+    outcomes, lock = [], threading.Lock()
+
+    def consume():
+        served = 0
+        try:
+            while True:
+                it.pull()
+                served += 1
+        except FaultInjected:
+            with lock:
+                outcomes.append(("fault", served))
+        except StopIteration:
+            with lock:
+                outcomes.append(("stop", served))
+
+    threads = [threading.Thread(target=consume) for _ in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30.0)
+        assert not t.is_alive(), "worker fault left a consumer blocked"
+    it.close()
+    kinds = sorted(k for k, _ in outcomes)
+    assert kinds == ["fault", "stop", "stop", "stop"]  # exactly once
+    assert sum(n for _, n in outcomes) == 3  # batches before the crash
+
+
+# -- deadlines ---------------------------------------------------------------
+
+def test_deadline_already_expired_rejected_at_enqueue():
+    net = _net()
+    batcher = MicroBatcher(net, auto_start=False)
+    with pytest.raises(DeadlineExceeded):
+        batcher.predict(_x(1, seed=0), deadline_ms=0)
+    assert batcher.stats()["deadline_misses"] == 1
+
+
+def test_deadline_evicts_queued_request_before_padding():
+    net = _net()
+    batcher = MicroBatcher(net, max_delay_ms=5000.0, auto_start=False)
+    errs = []
+
+    def client():
+        try:
+            batcher.predict(_x(1, seed=0), timeout=30.0, deadline_ms=40.0)
+        except DeadlineExceeded as e:
+            errs.append(e)
+
+    t = threading.Thread(target=client)
+    t.start()  # queued with the dispatcher not yet running
+    deadline = time.time() + 5.0
+    while batcher.queue_depth() < 1 and time.time() < deadline:
+        time.sleep(0.005)
+    time.sleep(0.08)  # let the 40ms deadline lapse in the queue
+    batcher.start()  # first dispatch pass evicts before coalescing
+    t.join(timeout=30.0)
+    assert not t.is_alive()
+    batcher.stop()
+    assert len(errs) == 1
+    st = batcher.stats()
+    assert st["deadline_misses"] == 1 and st["errors"] == 1
+    # nothing was executed for the dead request
+    assert st["requests"] == 0
+
+
+def test_deadline_met_when_dispatcher_is_live():
+    net = _net()
+    batcher = MicroBatcher(net, max_delay_ms=2.0)
+    out = batcher.predict(_x(2, seed=3), timeout=30.0, deadline_ms=20000.0)
+    batcher.stop()
+    assert out.shape == (2, N_OUT)
+    assert batcher.stats()["deadline_misses"] == 0
+
+
+# -- circuit breaker in the gateway: chaos serve -----------------------------
+
+def test_chaos_serve_breaker_opens_degrades_and_recovers():
+    """32 closed-loop clients while dispatcher faults are armed: every
+    response is either the correct activations or a clean exception — no
+    hangs — the breaker opens, serving degrades to the eager path
+    (bitwise-identical answers), and once the faults stop the breaker
+    recovers to CLOSED."""
+    net = _net()
+    net.warmup([32])
+    breaker = CircuitBreaker(failure_threshold=3, reset_timeout_s=0.05,
+                             probe_prob=1.0)
+    batcher = MicroBatcher(net, max_delay_ms=2.0, breaker=breaker)
+    clients = 32
+    xs = [_x(1 + i % 3, seed=i) for i in range(clients)]
+    direct = [np.asarray(net.output(x)) for x in xs]
+    # primary-path executions 2..7 fail: enough consecutive batch
+    # failures to open the breaker (threshold 3), then half-open probes
+    # burn through the rest of the window and the first clean probe
+    # closes it again
+    faults.arm("dispatcher.execute", "raise", nth=2, times=6)
+    wrong, errors, lock = [], [], threading.Lock()
+
+    def client(i):
+        for _ in range(6):
+            try:
+                got = batcher.predict(xs[i], timeout=30.0)
+                if not np.array_equal(direct[i], got):
+                    with lock:
+                        wrong.append(i)
+            except Exception as e:  # noqa: BLE001 — clean failure is OK
+                with lock:
+                    errors.append(repr(e))
+
+    threads = [threading.Thread(target=client, args=(i,))
+               for i in range(clients)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60.0)
+        assert not t.is_alive(), "chaos client hung"
+    assert not wrong, f"degraded/primary responses diverged: {wrong}"
+    # faults raise BEFORE the device call, so every faulted batch falls
+    # back to the degraded path and still answers correctly
+    assert not errors, errors[:3]
+
+    # drive recovery: cooldown -> half-open probe (prob=1.0) -> success
+    deadline = time.time() + 20.0
+    while breaker.state != CircuitBreaker.CLOSED and time.time() < deadline:
+        time.sleep(0.06)
+        try:
+            batcher.predict(xs[0], timeout=30.0)
+        except Exception:  # noqa: BLE001 — a probe may still hit a fault
+            pass
+    st = batcher.stats()
+    batcher.stop()
+    assert st["breaker"]["state"] == CircuitBreaker.CLOSED
+    assert st["breaker"]["opens"] >= 1, st["breaker"]
+    assert st["degraded_batches"] >= 1, st
+    assert st["degraded"] is False  # recovered
+
+
+def test_degraded_output_is_bitwise_eager_network_output():
+    net = _net()
+    x = _x(5, seed=11)
+    eager = np.asarray(network_output(net.conf, net.params, x))
+    # a pre-opened breaker (long cooldown) forces the degraded path
+    br = CircuitBreaker(failure_threshold=1, reset_timeout_s=600.0)
+    br.record_failure()
+    assert br.state == CircuitBreaker.OPEN
+    batcher = MicroBatcher(net, max_delay_ms=2.0, breaker=br)
+    got = batcher.predict(x, timeout=30.0)
+    st = batcher.stats()
+    batcher.stop()
+    np.testing.assert_array_equal(eager, got)
+    assert st["degraded_batches"] == 1 and st["degraded"] is True
+    assert faults.hits("dispatcher.execute") == 0  # primary never ran
+
+
+# -- server: health endpoints + graceful drain -------------------------------
+
+def test_healthz_readyz_and_drain_semantics():
+    net = _net()
+    server = net.serve(max_delay_ms=2.0)
+    try:
+        assert _http(server.url + "/healthz")[0] == 200
+        code, body = _http(server.url + "/readyz")
+        assert code == 200 and body["ready"] is True
+        code, body = _http(server.url + "/v1/predict",
+                           {"features": _x(2, seed=1).tolist(),
+                            "deadline_ms": 20000})
+        assert code == 200 and body["rows"] == 2
+        _, st = _http(server.url + "/v1/stats")
+        for key in ("ready", "draining", "inflight", "deadline_misses",
+                    "errors", "degraded", "breaker", "drain_timeout_s"):
+            assert key in st, key
+        assert st["ready"] is True and st["draining"] is False
+    finally:
+        server.drain(5.0)
+    assert not server.is_ready() and server.draining
+    with pytest.raises(ServerDraining):
+        server.predict(_x(1, seed=0))
+    assert server.enter_request() is False
+    server.drain(5.0)  # idempotent
+    assert server.wait_for_stop(timeout=0.0)  # drain flagged the stop event
+
+
+def test_expired_deadline_maps_to_http_504():
+    net = _net()
+    server = net.serve(max_delay_ms=2.0)
+    try:
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _http(server.url + "/v1/predict",
+                  {"features": _x(1, seed=0).tolist(), "deadline_ms": 0})
+        assert ei.value.code == 504
+        _, st = _http(server.url + "/v1/stats")
+        assert st["deadline_misses"] == 1
+    finally:
+        server.stop()
+
+
+def test_drain_under_load_answers_every_accepted_request():
+    net = _net()
+    net.warmup([8])
+    server = net.serve(max_delay_ms=2.0)
+    ok, refused, broken, lock = [], [], [], threading.Lock()
+    stop = threading.Event()
+
+    def client(i):
+        while not stop.is_set():
+            try:
+                code, _ = _http(server.url + "/v1/predict",
+                                {"features": _x(1, seed=i).tolist()},
+                                timeout=10)
+                with lock:
+                    ok.append(code)
+            except urllib.error.HTTPError as e:
+                with lock:
+                    refused.append(e.code)  # clean 503 during drain
+                return
+            except OSError:
+                with lock:
+                    broken.append(i)  # accept loop already closed
+                return
+
+    threads = [threading.Thread(target=client, args=(i,)) for i in range(8)]
+    for t in threads:
+        t.start()
+    deadline = time.time() + 5.0
+    while len(ok) < 8 and time.time() < deadline:
+        time.sleep(0.01)
+    assert len(ok) >= 8  # every client got real answers pre-drain
+    drain_thread = threading.Thread(target=server.drain, args=(10.0,))
+    drain_thread.start()
+    stop.set()
+    for t in threads:
+        t.join(timeout=30.0)
+        assert not t.is_alive(), "client hung across the drain"
+    drain_thread.join(timeout=30.0)
+    assert not drain_thread.is_alive()
+    assert all(c == 200 for c in ok)
+    assert all(c == 503 for c in refused)
+
+
+# -- the real thing: CLI serve process, SIGTERM, exit 0 ----------------------
+
+def test_cli_serve_sigterm_drains_and_exits_zero(tmp_path):
+    net = _net()
+    ckpt = str(tmp_path / "model")
+    checkpoint.save(ckpt, net.params, conf=net.conf)
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "deeplearning4j_tpu.cli", "serve",
+         "--model", ckpt, "--shapes", "4", "--port", "0",
+         "--max-delay-ms", "300", "--drain-timeout", "10"],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        cwd=repo, env=env)
+    try:
+        watchdog = threading.Timer(180.0, proc.kill)
+        watchdog.start()
+        try:
+            summary = json.loads(proc.stdout.readline())
+        finally:
+            watchdog.cancel()
+        url = summary["url"]
+        code, body = _http(url + "/v1/predict",
+                           {"features": _x(2, seed=1).tolist()}, timeout=60)
+        assert code == 200 and body["rows"] == 2
+
+        # leave a request IN FLIGHT (300ms coalescing window) when the
+        # SIGTERM lands: the drain must still answer it for real
+        inflight = {}
+
+        def straggler():
+            try:
+                inflight["resp"] = _http(
+                    url + "/v1/predict",
+                    {"features": _x(1, seed=2).tolist()}, timeout=30)
+            except Exception as e:  # noqa: BLE001
+                inflight["error"] = e
+
+        t = threading.Thread(target=straggler)
+        t.start()
+        time.sleep(0.1)
+        proc.send_signal(signal.SIGTERM)
+        t.join(timeout=60.0)
+        assert not t.is_alive()
+        assert "resp" in inflight, inflight.get("error")
+        assert inflight["resp"][0] == 200
+
+        out, err = proc.communicate(timeout=120)
+        assert proc.returncode == 0, (out, err)
+        drained = json.loads(out.strip().splitlines()[-1])
+        assert drained["drained"] is True
+        assert drained["requests"] >= 2
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.communicate()
+
+
+# -- crash-safe training: SIGTERM checkpoints, rerun resumes bit-for-bit -----
+
+def _toy_stream(batch=8, n=40, seed=3):
+    from deeplearning4j_tpu.datasets.dataset import (DataSet,
+                                                     labels_to_one_hot)
+    from deeplearning4j_tpu.datasets.iterator import ListDataSetIterator
+
+    rng = np.random.RandomState(seed)
+    x = rng.randn(n, N_IN).astype(np.float32)
+    y = labels_to_one_hot(rng.randint(0, N_OUT, n), N_OUT)
+    return ListDataSetIterator(DataSet(x, y), batch)
+
+
+class _SigtermAfter:
+    """Listener that exercises the REAL installed SIGTERM handler after
+    `after` batches (calling the handler in-process stands in for the
+    kernel delivering the signal, without risking the test runner)."""
+
+    def __init__(self, after):
+        self.after, self.n = after, 0
+
+    def iteration_done(self, model, iteration, score):
+        self.n += 1
+        if self.n == self.after:
+            handler = signal.getsignal(signal.SIGTERM)
+            assert callable(handler), "fit did not install a SIGTERM handler"
+            handler(signal.SIGTERM, None)
+
+
+def test_sigterm_checkpoints_then_rerun_resumes_bit_for_bit(tmp_path):
+    conf = mlp(n_in=N_IN, hidden=[8], n_out=N_OUT, lr=0.05)
+    ck = str(tmp_path / "ck")
+
+    ref = MultiLayerNetwork(conf, seed=7).init()
+    ref.fit(_toy_stream())  # uninterrupted 5-batch run
+    ref_flat = np.asarray(ref.params_flat())
+
+    n1 = MultiLayerNetwork(conf, seed=7).init()
+    n1.set_listeners([_SigtermAfter(2)])
+    with pytest.raises(TrainingInterrupted):
+        n1.fit(_toy_stream(), checkpoint_dir=ck)
+    _, _, meta = checkpoint.load(ck)
+    assert meta["data_cursor"]["batches_done"] == 2
+    assert "rng_key" in meta["metadata"]
+
+    n2 = MultiLayerNetwork(conf, seed=7).init()  # fresh "process"
+    n2.fit(_toy_stream(), checkpoint_dir=ck)  # auto-resumes at batch 2
+    flat2 = np.asarray(n2.params_flat())
+    assert ref_flat.dtype == np.float32
+    assert np.array_equal(ref_flat, flat2), "resume is not bit-identical"
+    # final checkpoint advanced to the full stream
+    _, _, meta = checkpoint.load(ck)
+    assert meta["data_cursor"]["batches_done"] == 5
+
+
+def test_periodic_checkpoint_and_stop_flag(tmp_path):
+    ck = str(tmp_path / "ck")
+    net = _net(seed=1)
+    net.fit(_toy_stream(), checkpoint_dir=ck, checkpoint_every_n_batches=2)
+    _, _, meta = checkpoint.load(ck)
+    assert meta["data_cursor"]["batches_done"] == 5
+    assert os.path.isdir(ck) and not os.path.isdir(ck + ".bak")
+
+    # request_stop_training (from a listener, i.e. mid-run) checkpoints
+    # and raises after the current batch
+    class _Stop:
+        def iteration_done(self, model, iteration, score):
+            model.request_stop_training()
+
+    net2 = _net(seed=1)
+    net2.set_listeners([_Stop()])
+    with pytest.raises(TrainingInterrupted):
+        net2.fit(_toy_stream(), checkpoint_dir=str(tmp_path / "ck2"),
+                 auto_resume=False)
+    _, _, meta = checkpoint.load(str(tmp_path / "ck2"))
+    assert meta["data_cursor"]["batches_done"] == 1
+
+
+# -- CLI flags ----------------------------------------------------------------
+
+def test_cli_resilience_flags_parse():
+    from deeplearning4j_tpu.cli.driver import build_parser
+
+    args = build_parser().parse_args(
+        ["serve", "--model", "m", "--drain-timeout", "3.5",
+         "--request-timeout", "12", "--default-deadline-ms", "250"])
+    assert args.drain_timeout == 3.5
+    assert args.request_timeout == 12.0
+    assert args.default_deadline_ms == 250.0
+    args = build_parser().parse_args(["serve", "--model", "m"])
+    assert args.drain_timeout == 10.0 and args.default_deadline_ms is None
+
+    args = build_parser().parse_args(
+        ["train", "--input", "d.csv", "--output", "o",
+         "--zoo", "mlp", "--checkpoint-dir", "ckpts/run1"])
+    assert args.checkpoint_dir == "ckpts/run1"
