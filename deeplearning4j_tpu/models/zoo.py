@@ -156,10 +156,14 @@ def fit_deep_autoencoder(net, x):
 
 
 def char_lstm(vocab: int, hidden: int = 256, n_layers: int = 1,
-              lr: float = 0.1, iterations: int = 1
-              ) -> MultiLayerConfiguration:
+              lr: float = 0.1, iterations: int = 1,
+              sparse_labels: bool = False) -> MultiLayerConfiguration:
     """char-LSTM (BASELINE configs[1]; reference `LSTM.java:53` is a
-    1-layer karpathy char-LSTM with fused iFog gates + decoder)."""
+    1-layer karpathy char-LSTM with fused iFog gates + decoder).
+
+    `sparse_labels=True` declares that training feeds int class-id targets
+    (shape [batch*seq]) instead of one-hot rows — the mcxent gather path,
+    bitwise-identical loss without the [rows, vocab] one-hot gemm."""
     b = _base(lr=lr, iters=iterations)
     confs = []
     for i in range(n_layers):
@@ -169,7 +173,8 @@ def char_lstm(vocab: int, hidden: int = 256, n_layers: int = 1,
                                activation=Activation.TANH))
     confs.append(b.replace(layer_type=LayerType.OUTPUT, n_in=hidden,
                            n_out=vocab, activation=Activation.SOFTMAX,
-                           loss_function=LossFunction.MCXENT))
+                           loss_function=LossFunction.MCXENT,
+                           sparse_labels=sparse_labels))
     return MultiLayerConfiguration(
         confs=tuple(confs), backprop=True,
         # output layer consumes per-timestep features
@@ -214,23 +219,36 @@ def vgg_cifar10(lr: float = 0.05, iterations: int = 1,
 def char_transformer(vocab: int, d_model: int = 128, n_blocks: int = 2,
                      n_heads: int = 4, max_seq_len: int = 256,
                      lr: float = 1e-3, iterations: int = 1,
-                     updater: str = "adam") -> MultiLayerConfiguration:
+                     updater: str = "adam", sparse_labels: bool = False,
+                     fused_updater: bool = False,
+                     attention_block_skip: bool = False
+                     ) -> MultiLayerConfiguration:
     """Decoder-only char transformer LM (new scope — the reference's only
     sequence model is the scalar-loop LSTM).  Embedding (+ learned
     positions) -> n_blocks x [causal MHA, FFN] -> per-token softmax.
     Trains with Adam by default (the flagship wants it; plain SGD+momentum
-    trains transformers poorly)."""
-    b = _base(lr=lr, iters=iterations, updater=updater)
+    trains transformers poorly).
+
+    The three keyword flags are the MFU-campaign hot-path switches (all
+    value-preserving; see tests/test_mfu_paths.py): `sparse_labels` trains
+    against int class-id targets via the mcxent gather path,
+    `fused_updater` runs the optimizer on flat buffers, and
+    `attention_block_skip` drops mask arithmetic on fully-causal flash
+    tiles."""
+    b = _base(lr=lr, iters=iterations, updater=updater,
+              fused_updater=fused_updater)
     confs = [b.replace(layer_type=LayerType.EMBEDDING, n_in=vocab,
                        n_out=d_model, max_seq_len=max_seq_len)]
     for _ in range(n_blocks):
         confs.append(b.replace(layer_type=LayerType.ATTENTION, n_in=d_model,
-                               n_out=d_model, n_heads=n_heads, causal=True))
+                               n_out=d_model, n_heads=n_heads, causal=True,
+                               attention_block_skip=attention_block_skip))
         confs.append(b.replace(layer_type=LayerType.TRANSFORMER_FFN,
                                n_in=d_model, n_out=d_model))
     confs.append(b.replace(layer_type=LayerType.OUTPUT, n_in=d_model,
                            n_out=vocab, activation=Activation.SOFTMAX,
-                           loss_function=LossFunction.MCXENT))
+                           loss_function=LossFunction.MCXENT,
+                           sparse_labels=sparse_labels))
     return MultiLayerConfiguration(
         confs=tuple(confs), backprop=True,
         input_preprocessors=((2 * n_blocks + 1, "rnn_to_ff"),))

@@ -50,7 +50,36 @@ def _f32(x: jnp.ndarray) -> jnp.ndarray:
 
 # -- per-example forms (last axis reduced; leading axes preserved) ----------
 
+def _is_sparse(labels) -> bool:
+    """Int-dtype labels are class ids (sparse); floats are dense rows."""
+    return jnp.issubdtype(jnp.asarray(labels).dtype, jnp.integer)
+
+
+def mcxent_sparse_rows(labels, output):
+    """mcxent for integer class-id labels: gather instead of one-hot gemm.
+
+    Bitwise-f32-identical to `mcxent_rows(one_hot(labels), output)`:
+    the one-hot form's sum is `0.0 * log(clip(p_j))` on every off-label
+    column (exact 0.0 — clip keeps the log finite) plus the label column,
+    and a float32 sum of exact zeros and one value is that value.  The
+    gather therefore removes the [rows, vocab] materialization and its
+    fwd+bwd HBM traffic without changing a single bit of loss or grad
+    (grads: only the label column has nonzero cotangent either way).
+
+    Bucket padding stays bit-exact through the *weighted* forms: a padded
+    row carries class id 0 (`pad_batch` zero-pads int labels) and produces
+    a finite `-log(clip(p[0]))`, which its 0.0 sample weight multiplies to
+    an exact 0.0 in `dot(rows, w)` — same contribution (and same zero
+    cotangent) as the all-zero one-hot row it replaces.
+    """
+    idx = jnp.asarray(labels)[..., None]
+    picked = jnp.take_along_axis(_f32(output), idx, axis=-1)[..., 0]
+    return -jnp.log(_clip(picked))
+
+
 def mcxent_rows(labels, output):
+    if _is_sparse(labels):
+        return mcxent_sparse_rows(labels, output)
     return -jnp.sum(_f32(labels) * jnp.log(_clip(_f32(output))), axis=-1)
 
 
@@ -99,9 +128,28 @@ _ROWWISE = {
 }
 
 
+# losses whose rowwise form understands integer class-id labels
+_SPARSE_OK = {LossFunction.MCXENT, LossFunction.NEGATIVELOGLIKELIHOOD}
+
+
+def _checked(lf, base):
+    if lf in _SPARSE_OK:
+        return base
+
+    def f(labels, output):
+        if _is_sparse(labels):
+            raise TypeError(
+                f"integer (sparse) labels are only supported for "
+                f"mcxent-family losses, not {lf}")
+        return base(labels, output)
+
+    return f
+
+
 def get_rowwise(fn) -> callable:
     """Per-example loss `(labels, output) -> [batch]` for sample weighting."""
-    return _ROWWISE[LossFunction(str(fn).lower())]
+    lf = LossFunction(str(fn).lower())
+    return _checked(lf, _ROWWISE[lf])
 
 
 # -- batch-mean forms (the reference's scoring surface) ---------------------
@@ -136,7 +184,8 @@ _LOSSES = {
 
 
 def get_loss(fn) -> callable:
-    return _LOSSES[LossFunction(str(fn).lower())]
+    lf = LossFunction(str(fn).lower())
+    return _checked(lf, _LOSSES[lf])
 
 
 def score(labels, loss_fn, output, l2: float = 0.0, params_l2_norm_sq=None):

@@ -31,6 +31,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from deeplearning4j_tpu.nd.attention import blockwise_attention
+from deeplearning4j_tpu.nd.platform import is_tpu
 
 # jax 0.5 renamed TPUCompilerParams -> CompilerParams and grew a
 # has_side_effects field; build the params compatibly for either version
@@ -50,17 +51,66 @@ _NEG_BIG = -1e30
 def _interpret(flag: Optional[bool]) -> bool:
     if flag is not None:
         return flag
-    return jax.devices()[0].platform != "tpu"
+    # cached: jax.devices() takes the backend lock and this runs on every
+    # kernel invocation site (satellite: was a per-call devices() query)
+    return not is_tpu()
+
+
+# Measured block-size table for the flash kernel, keyed by (seq, head_dim).
+# Provenance: TPU v5 lite sweeps at BENCH_r02 shapes (block pairs within the
+# 16 MiB VMEM budget; larger K blocks amortize the loop overhead at long S,
+# larger Q blocks stop paying once the per-tile [block_q, block_k] f32
+# scores tile crowds out double-buffered K/V).  Entries not present fall
+# back to the heuristic below; re-run bench_transformer_mfu on new shapes
+# to extend the table.
+_BLOCK_TABLE = {
+    (256, 32): (128, 128),
+    (256, 64): (128, 128),
+    (512, 64): (128, 256),
+    (1024, 64): (128, 256),
+    (1024, 128): (128, 256),
+    (2048, 64): (256, 256),
+    (2048, 128): (256, 256),
+    (4096, 128): (256, 512),
+}
+
+
+def pick_attention_blocks(seq: int, head_dim: int) -> tuple:
+    """(block_q, block_k) for `flash_attention` at this (S, head_dim).
+
+    Table hit -> measured sizes; miss -> largest power-of-two blocks that
+    divide S (the kernel requires S % block == 0; ragged S falls back to
+    `blockwise_attention` anyway), capped at 256/512 to stay inside VMEM
+    with f32 scores tiles.
+    """
+    hit = _BLOCK_TABLE.get((seq, head_dim))
+    if hit is not None:
+        return hit
+
+    def fit(cap):
+        b = 8
+        while b * 2 <= cap and seq % (b * 2) == 0:
+            b *= 2
+        return b
+
+    return (fit(256), fit(512)) if seq % 8 == 0 else (128, 128)
 
 
 # ---------------------------------------------------------------- attention
 
 def _flash_attn_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int,
-                       causal: bool, q_block: int, scale: float):
+                       causal: bool, q_block: int, scale: float,
+                       block_skip: bool = False):
     """One Q tile vs all KV tiles, online softmax in VMEM.
 
     q_ref: [block_q, D]; k_ref/v_ref: [S, D]; o_ref: [block_q, D].
     Grid: (BH, num_q_blocks) — batch*heads is grid dim 0.
+
+    `block_skip` (causal only) splits the KV loop at the diagonal: tiles
+    strictly below it need no mask at all (every kpos < every qpos, so
+    `where(kpos <= qpos, s, NEG)` is the identity there — the split is
+    bitwise-identical, it just skips the iota/compare/select work on the
+    ~half of tiles where the mask is a no-op).
     """
     qi = pl.program_id(1)
     s_total = k_ref.shape[0]
@@ -69,40 +119,52 @@ def _flash_attn_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int,
 
     q = q_ref[:] * scale
 
-    def body(j, carry):
-        o, m, l = carry
-        k = k_ref[pl.ds(j * block_k, block_k), :]
-        v = v_ref[pl.ds(j * block_k, block_k), :]
-        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32)
-        if causal:
-            qpos = qi * q_block + lax.broadcasted_iota(
-                jnp.int32, (q_block, block_k), 0)
-            kpos = j * block_k + lax.broadcasted_iota(
-                jnp.int32, (q_block, block_k), 1)
-            s = jnp.where(kpos <= qpos, s, _NEG_BIG)
-        m_new = jnp.maximum(m, jnp.max(s, axis=1, keepdims=True))
-        alpha = jnp.exp(m - m_new)
-        p = jnp.exp(s - m_new)
-        l_new = l * alpha + jnp.sum(p, axis=1, keepdims=True)
-        o_new = o * alpha + jnp.dot(p.astype(v.dtype), v,
-                                    preferred_element_type=jnp.float32)
-        return o_new, m_new, l_new
+    def make_body(masked):
+        def body(j, carry):
+            o, m, l = carry
+            k = k_ref[pl.ds(j * block_k, block_k), :]
+            v = v_ref[pl.ds(j * block_k, block_k), :]
+            s = jnp.dot(q, k.T, preferred_element_type=jnp.float32)
+            if masked:
+                qpos = qi * q_block + lax.broadcasted_iota(
+                    jnp.int32, (q_block, block_k), 0)
+                kpos = j * block_k + lax.broadcasted_iota(
+                    jnp.int32, (q_block, block_k), 1)
+                s = jnp.where(kpos <= qpos, s, _NEG_BIG)
+            m_new = jnp.maximum(m, jnp.max(s, axis=1, keepdims=True))
+            alpha = jnp.exp(m - m_new)
+            p = jnp.exp(s - m_new)
+            l_new = l * alpha + jnp.sum(p, axis=1, keepdims=True)
+            o_new = o * alpha + jnp.dot(p.astype(v.dtype), v,
+                                        preferred_element_type=jnp.float32)
+            return o_new, m_new, l_new
 
-    o0 = jnp.zeros((q_block, d), jnp.float32)
-    m0 = jnp.full((q_block, 1), _NEG_BIG, jnp.float32)
-    l0 = jnp.zeros((q_block, 1), jnp.float32)
+        return body
+
+    carry = (jnp.zeros((q_block, d), jnp.float32),
+             jnp.full((q_block, 1), _NEG_BIG, jnp.float32),
+             jnp.zeros((q_block, 1), jnp.float32))
     if causal:
         # tiles strictly after this q tile's last row contribute nothing
         nk_needed = lax.min(((qi + 1) * q_block + block_k - 1) // block_k,
                             nk)
+        if block_skip:
+            # tile j is fully unmasked iff its last key position
+            # (j+1)*block_k - 1 <= first query position qi*q_block
+            nk_full = (qi * q_block) // block_k
+            carry = lax.fori_loop(0, nk_full, make_body(False), carry)
+            carry = lax.fori_loop(nk_full, nk_needed, make_body(True), carry)
+        else:
+            carry = lax.fori_loop(0, nk_needed, make_body(True), carry)
     else:
-        nk_needed = nk
-    o, m, l = lax.fori_loop(0, nk_needed, body, (o0, m0, l0))
+        carry = lax.fori_loop(0, nk, make_body(False), carry)
+    o, m, l = carry
     o_ref[:] = (o / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
 
 
 def _flash_attention_fwd_impl(q, k, v, causal: bool, block_q: int,
-                              block_k: int, interpret: Optional[bool]):
+                              block_k: int, interpret: Optional[bool],
+                              block_skip: bool = False):
     b, s, h, d = q.shape
     bh = b * h
     # [B,S,H,D] -> [BH,S,D]
@@ -117,7 +179,8 @@ def _flash_attention_fwd_impl(q, k, v, causal: bool, block_q: int,
     grid = (bh, s // block_q)
     scale = 1.0 / (d ** 0.5)
     kernel = functools.partial(_flash_attn_kernel, block_k=block_k,
-                               causal=causal, q_block=block_q, scale=scale)
+                               causal=causal, q_block=block_q, scale=scale,
+                               block_skip=block_skip and causal)
     out = pl.pallas_call(
         kernel,
         out_shape=jax.ShapeDtypeStruct((bh, s, d), q.dtype),
@@ -133,26 +196,29 @@ def _flash_attention_fwd_impl(q, k, v, causal: bool, block_q: int,
     return out.reshape(b, h, s, d).transpose(0, 2, 1, 3)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
 def flash_attention(q, k, v, causal: bool = False, block_q: int = 128,
-                    block_k: int = 128, interpret: Optional[bool] = None):
+                    block_k: int = 128, interpret: Optional[bool] = None,
+                    block_skip: bool = False):
     """Flash attention: [B,S,H,D] inputs, Pallas forward, recompute backward.
 
     Backward recomputes attention blockwise (flash-style memory profile) via
     the jax-level implementation's VJP, so grads never materialize [S,S]
-    either.
+    either.  `block_skip=True` (causal only) splits the kernel's KV loop at
+    the diagonal so fully-unmasked tiles skip the mask arithmetic — same
+    values, fewer VPU ops; see `_flash_attn_kernel`.
     """
     return _flash_attention_fwd_impl(q, k, v, causal, block_q, block_k,
-                                     interpret)
+                                     interpret, block_skip)
 
 
-def _flash_fwd(q, k, v, causal, block_q, block_k, interpret):
+def _flash_fwd(q, k, v, causal, block_q, block_k, interpret, block_skip):
     out = _flash_attention_fwd_impl(q, k, v, causal, block_q, block_k,
-                                    interpret)
+                                    interpret, block_skip)
     return out, (q, k, v)
 
 
-def _flash_bwd(causal, block_q, block_k, interpret, res, g):
+def _flash_bwd(causal, block_q, block_k, interpret, block_skip, res, g):
     q, k, v = res
     _, vjp = jax.vjp(
         lambda q, k, v: blockwise_attention(q, k, v, block_size=block_k,

@@ -1,0 +1,24 @@
+"""Cached default-platform detection.
+
+`jax.devices()[0].platform` acquires the backend client lock on every
+call; kernel dispatch sites (`nd/pallas_kernels._interpret`, the
+`attention_impl="auto"` crossover) ask on every trace, so the answer is
+memoized once per process.  The platform cannot change after the first
+backend initialization, so a process-lifetime cache is safe.
+"""
+
+from __future__ import annotations
+
+import functools
+
+
+@functools.lru_cache(maxsize=None)
+def default_platform() -> str:
+    """Platform string of the default jax backend ("cpu"/"gpu"/"tpu")."""
+    import jax
+
+    return jax.devices()[0].platform
+
+
+def is_tpu() -> bool:
+    return default_platform() == "tpu"

@@ -165,9 +165,19 @@ class NeuralNetConfiguration:
     causal: bool = False
     attention_block_size: int = 0  # 0 = full attention; >0 = blockwise/flash
     attention_impl: str = "auto"   # auto | full | blockwise | flash (pallas)
+    # skip the mask arithmetic on fully-unmasked causal flash tiles (MFU
+    # campaign leg d; value-identical, gated for A/B benching)
+    attention_block_skip: bool = False
     ffn_hidden: int = 0            # transformer FFN width (0 = 4*n_in)
     max_seq_len: int = 0           # >0: learned positional embedding table
     lstm_impl: str = "auto"        # auto | scan | fused (pallas cell)
+
+    # MFU campaign hot-path flags (each bitwise-f32-identical to the path
+    # it replaces; parity-tested in tests/test_mfu_paths.py)
+    sparse_labels: bool = False    # int class-id labels: gather mcxent, no
+                                   # [rows, vocab] one-hot gemm
+    fused_updater: bool = False    # flat-buffer updater step instead of
+                                   # O(leaves) per-leaf tree_maps
 
     # batch-norm running-stat decay (ema = m*ema + (1-m)*batch)
     batch_norm_momentum: float = 0.9

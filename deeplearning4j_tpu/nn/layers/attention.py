@@ -15,6 +15,7 @@ import jax
 import jax.numpy as jnp
 
 from deeplearning4j_tpu.nd import random as ndr
+from deeplearning4j_tpu.nd.platform import is_tpu
 from deeplearning4j_tpu.nn.weights import init_weights
 from deeplearning4j_tpu.nn.layers.base import compute_dtype, mixed_matmul
 from deeplearning4j_tpu.nd.attention import (blockwise_attention,
@@ -65,9 +66,10 @@ class MultiHeadAttentionLayer:
         k = k.reshape(b, s, h, hd)
         v = v.reshape(b, s, h, hd)
         blk = conf.attention_block_size
+        skip = conf.attention_block_skip and conf.causal
         impl = conf.attention_impl
         if impl == "auto":
-            if jax.devices()[0].platform == "tpu":
+            if is_tpu():
                 # measured on v5e: XLA's dense attention (heads batched into
                 # big MXU matmuls) beats the Pallas flash kernel up through
                 # S=2048 (224 vs 432 ms/step at S=2048); beyond that the
@@ -79,13 +81,21 @@ class MultiHeadAttentionLayer:
                 # per block (8 blocks x 2 GiB at S=1024 runs fine), and b
                 # here is the per-device batch under shard_map. Overrides:
                 # conf.attention_impl pins an impl, conf.remat frees HBM.
+                # With the causal block-skip the flash kernel does ~half the
+                # tile visits, moving the crossover one doubling earlier
+                # (analytic shift off the same v5e sweep; re-measure when a
+                # chip is claimable).
                 scores_bytes = 4 * b * h * s * s  # f32 fwd scores
-                impl = "full" if scores_bytes <= (8 << 30) else "flash"
+                bound = (4 << 30) if skip else (8 << 30)
+                impl = "full" if scores_bytes <= bound else "flash"
             else:
                 impl = "blockwise" if blk else "full"
         if impl == "flash":
-            from deeplearning4j_tpu.nd.pallas_kernels import flash_attention
-            o = flash_attention(q, k, v, conf.causal, blk or 128, blk or 128)
+            from deeplearning4j_tpu.nd.pallas_kernels import (
+                flash_attention, pick_attention_blocks)
+            bq, bk = (blk, blk) if blk else pick_attention_blocks(s, hd)
+            o = flash_attention(q, k, v, conf.causal, bq, bk,
+                                block_skip=skip)
         elif impl == "blockwise":
             o = blockwise_attention(q, k, v, block_size=blk or 512,
                                     causal=conf.causal)

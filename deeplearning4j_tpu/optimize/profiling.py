@@ -1,0 +1,153 @@
+"""Per-op cost accounting for the MFU campaign.
+
+MFU alone says *that* a train step is slow, not *where*.  This module
+splits a transformer train step's cost into the op categories the
+campaign's hot-path work targets —
+
+  matmul      weight GEMMs (qkv / proj / ffn / logits), fwd + bwd
+  attention   the S x S score + value products per head, fwd + bwd
+  elementwise layernorm / gelu / softmax / residual traffic
+  updater     the optimizer chain over every parameter
+  transfer    host -> device batch bytes per step
+
+— from two independent sources that cross-check each other:
+
+  1. analytic counts from the model dimensions alone
+     (`transformer_step_costs`), exact for matmul/attention (the standard
+     6*P*tokens + 12*S*d per token per block accounting) and coarse,
+     coefficient-documented estimates for the rest;
+  2. XLA's own totals for the AOT-compiled executable
+     (`compiled_totals` via `compiled.cost_analysis()`), available on
+     TPU *and* CPU, so the breakdown ships in every bench artifact even
+     when the device claim falls back.
+
+`breakdown` reconciles the two: per-category flops/bytes plus the
+`unattributed` remainder of the measured total the analytic model does
+not cover (fusion overheads, reductions, masking...).  A large
+unattributed share is itself a finding — it means the step is burning
+FLOPs outside the modelled hot paths.
+
+On TPU, `maybe_trace` additionally captures a real `jax.profiler` trace
+(op-level timeline, Perfetto-loadable) around the timed loop; off-TPU it
+is a no-op so the bench path never forks.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import NamedTuple
+
+CATEGORIES = ("matmul", "attention", "elementwise", "updater", "transfer")
+
+
+class OpCost(NamedTuple):
+    flops: float
+    bytes: float
+
+
+def transformer_step_costs(*, batch: int, seq: int, d_model: int,
+                           n_blocks: int, vocab: int, n_params: int,
+                           dtype_bytes: int = 2,
+                           sparse_labels: bool = False) -> dict:
+    """Analytic per-category costs for ONE char-transformer train step.
+
+    Exact pieces (standard dense-transformer accounting):
+      matmul GEMM params  P_mm = 12*d^2 per block (qkv 3d^2 + proj d^2 +
+      ffn up/down 8d^2) + d*vocab logits; fwd+bwd = 6 * P_mm * tokens.
+      attention = 12 * n_blocks * tokens * seq * d_model (scores + values,
+      2*2*S*d per token per block fwd, x3 for bwd).
+
+    Coarse pieces (coefficients below, documented not derived):
+      elementwise: ~60 flops per activation element per block fwd+bwd
+      (2 layernorms ~20, gelu ~16, softmax ~8, residuals/bias ~4, x2 bwd).
+      updater: ~12 flops/param (chain: decay, moment updates, scale,
+      clip norms), f32 traffic = 4 reads (param, grad, 2 state) +
+      3 writes (param, 2 state).
+
+    transfer counts the per-step host->device batch bytes: int32 ids for
+    x, and labels either int32 ids (sparse) or a one-hot [tokens, vocab]
+    row matrix — the whole point of `sparse_labels` is this vocab-fold
+    reduction plus the gathered (never materialized) one-hot in the loss.
+    """
+    tokens = batch * seq
+    p_mm = 12 * n_blocks * d_model * d_model + d_model * vocab
+    matmul = OpCost(6.0 * p_mm * tokens,
+                    3.0 * p_mm * dtype_bytes)  # weights read fwd+bwd+gradw
+    attention = OpCost(12.0 * n_blocks * tokens * seq * d_model,
+                       # q/k/v/scores read+write per block, fwd+bwd ~ 3x
+                       3.0 * n_blocks * (3 * tokens * d_model
+                                         + batch * seq * seq) * dtype_bytes)
+    elementwise = OpCost(60.0 * n_blocks * tokens * d_model,
+                         6.0 * n_blocks * tokens * d_model * dtype_bytes)
+    updater = OpCost(12.0 * n_params, 7.0 * n_params * 4)
+    label_bytes = tokens * (4 if sparse_labels else vocab * dtype_bytes)
+    transfer = OpCost(0.0, tokens // max(seq, 1) * seq * 4 + label_bytes)
+    return {"matmul": matmul, "attention": attention,
+            "elementwise": elementwise, "updater": updater,
+            "transfer": transfer}
+
+
+def compiled_totals(compiled) -> dict | None:
+    """XLA's flop/byte totals for an AOT-compiled executable, or None
+    when the backend doesn't expose `cost_analysis` (never raises — the
+    bench must emit a breakdown even on exotic backends)."""
+    try:
+        cost = compiled.cost_analysis()
+        cost = cost[0] if isinstance(cost, (list, tuple)) else cost
+        flops = float(cost.get("flops", 0.0))
+        nbytes = float(cost.get("bytes accessed", 0.0))
+        if flops <= 0.0 and nbytes <= 0.0:
+            return None
+        return {"flops": flops, "bytes": nbytes}
+    except Exception:
+        return None
+
+
+def breakdown(analytic: dict, totals: dict | None = None,
+              step_seconds: float | None = None) -> dict:
+    """Reconcile analytic per-category costs against measured totals.
+
+    Returns a JSON-ready dict: per category {flops, bytes, flop_fraction}
+    (fractions of the MEASURED total when available, else of the analytic
+    sum), the measured totals, and the `unattributed` remainder — measured
+    minus modelled, floored at 0.  With `step_seconds`, each category also
+    gets its implied TFLOP/s so the hot spot reads directly off the JSON.
+    """
+    modelled_flops = sum(c.flops for c in analytic.values())
+    total_flops = (totals or {}).get("flops") or modelled_flops
+    out = {"categories": {}, "modelled_flops": modelled_flops}
+    for name in CATEGORIES:
+        c = analytic.get(name)
+        if c is None:
+            continue
+        entry = {"flops": c.flops, "bytes": c.bytes,
+                 "flop_fraction": round(c.flops / total_flops, 4)
+                 if total_flops else 0.0}
+        if step_seconds:
+            entry["tflops_per_sec"] = round(c.flops / step_seconds / 1e12, 3)
+        out["categories"][name] = entry
+    if totals:
+        out["measured_flops"] = totals["flops"]
+        out["measured_bytes"] = totals["bytes"]
+        out["unattributed_flops"] = max(0.0,
+                                        totals["flops"] - modelled_flops)
+        out["unattributed_fraction"] = round(
+            out["unattributed_flops"] / totals["flops"], 4) \
+            if totals["flops"] else 0.0
+    return out
+
+
+@contextlib.contextmanager
+def maybe_trace(trace_dir: str | None = None):
+    """`jax.profiler.trace` around the body when a dir is given AND the
+    backend is a real TPU; a no-op otherwise (CPU traces of a bench loop
+    are all host callback noise — not worth the artifact bytes)."""
+    from deeplearning4j_tpu.nd.platform import is_tpu
+
+    if trace_dir and is_tpu():
+        import jax
+
+        with jax.profiler.trace(trace_dir):
+            yield
+    else:
+        yield

@@ -33,6 +33,7 @@ from deeplearning4j_tpu.nn.multilayer import (MultiLayerNetwork, has_batchnorm,
                                               network_rowwise_loss,
                                               update_bn_ema_from_stats)
 from deeplearning4j_tpu.optimize.updater import (UpdaterState, adjust_gradient,
+                                                 adjust_gradient_auto,
                                                  init_updater)
 from deeplearning4j_tpu.parallel.mesh import shard_batch
 from deeplearning4j_tpu.parallel.sequence import _as_varying, _shard_map
@@ -185,8 +186,8 @@ def make_dp_train_step(conf: MultiLayerConfiguration, mesh: Mesh,
         reduce = jax.lax.pmean if w is None else jax.lax.psum
         grads = reduce(grads, axis)
         score = reduce(score, axis)
-        adj, upd = adjust_gradient(out_conf, state.step, grads,
-                                   state.params, state.updater)
+        adj, upd = adjust_gradient_auto(out_conf, state.step, grads,
+                                        state.params, state.updater)
         params = jax.tree_util.tree_map(
             lambda p, a: p - a.astype(p.dtype), state.params, adj)
         if collect_bn:
@@ -234,8 +235,8 @@ def make_sharded_train_step(conf: MultiLayerConfiguration, mesh: Mesh,
 
         (score, stats), grads = jax.value_and_grad(
             loss_fn, has_aux=True)(state.params, key)
-        adj, upd = adjust_gradient(out_conf, state.step, grads,
-                                   state.params, state.updater)
+        adj, upd = adjust_gradient_auto(out_conf, state.step, grads,
+                                        state.params, state.updater)
         params = jax.tree_util.tree_map(
             lambda p, a: p - a.astype(p.dtype), state.params, adj)
         if collect_bn:

@@ -1,0 +1,350 @@
+"""Parity tests for the MFU-campaign hot paths.
+
+Each optimized path is gated by a conf flag and claims BITWISE f32
+identity (sparse labels, fused updater) or reference-tolerance identity
+(flash block-skip) with the path it replaces — these tests are the
+claim's enforcement.  Flag combinations are also exercised end-to-end
+through `MultiLayerNetwork.finetune` (the compiled step-cache program),
+so the parity holds through tracing, donation and the solver scan, not
+just at the op level.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nd import losses
+from deeplearning4j_tpu.nd.attention import full_attention
+from deeplearning4j_tpu.nd.pallas_kernels import (flash_attention,
+                                                  pick_attention_blocks)
+from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+from deeplearning4j_tpu.optimize.updater import (UpdaterState,
+                                                 adjust_gradient,
+                                                 adjust_gradient_auto,
+                                                 adjust_gradient_flat,
+                                                 flat_norm, flat_ravel,
+                                                 flat_unravel, init_updater,
+                                                 make_flat_spec, tree_norm)
+
+
+def _assert_tree_bitwise(a, b, where=""):
+    la, ta = jax.tree_util.tree_flatten(a)
+    lb, tb = jax.tree_util.tree_flatten(b)
+    assert ta == tb, f"tree structure mismatch {where}"
+    for i, (x, y) in enumerate(zip(la, lb)):
+        assert x.dtype == y.dtype and x.shape == y.shape, \
+            f"leaf {i} meta mismatch {where}"
+        assert np.array_equal(np.asarray(x), np.asarray(y)), \
+            f"leaf {i} bits differ {where}"
+
+
+# -- sparse-label loss path --------------------------------------------------
+
+def _softmax_rows(key, rows, vocab):
+    logits = jax.random.normal(key, (rows, vocab), jnp.float32)
+    return jax.nn.softmax(logits, axis=-1)
+
+
+def test_sparse_mcxent_bitwise_value_and_grad():
+    key = jax.random.PRNGKey(0)
+    rows, vocab = 40, 13
+    p = _softmax_rows(key, rows, vocab)
+    ids = jax.random.randint(jax.random.PRNGKey(1), (rows,), 0, vocab)
+    one_hot = jax.nn.one_hot(ids, vocab, dtype=jnp.float32)
+
+    dense = losses.mcxent_rows(one_hot, p)
+    sparse = losses.mcxent_rows(ids.astype(jnp.int32), p)
+    _assert_tree_bitwise(dense, sparse, "mcxent rows")
+
+    g_dense = jax.grad(lambda o: jnp.mean(losses.mcxent_rows(one_hot, o)))(p)
+    g_sparse = jax.grad(lambda o: jnp.mean(losses.mcxent_rows(ids, o)))(p)
+    _assert_tree_bitwise(g_dense, g_sparse, "mcxent grad")
+
+
+def test_sparse_mcxent_padded_tail_weighted_bitwise():
+    """Pad rows carry class id 0 and weight 0.0 (`pad_batch` convention):
+    the weighted loss and its gradient must match the one-hot path's
+    all-zero pad rows bit for bit."""
+    key = jax.random.PRNGKey(2)
+    real, pad, vocab = 24, 8, 11
+    p = _softmax_rows(key, real + pad, vocab)
+    ids = np.zeros(real + pad, np.int32)
+    ids[:real] = np.asarray(
+        jax.random.randint(jax.random.PRNGKey(3), (real,), 0, vocab))
+    one_hot = np.zeros((real + pad, vocab), np.float32)
+    one_hot[np.arange(real), ids[:real]] = 1.0  # pad rows stay all-zero
+    w = jnp.asarray(np.r_[np.ones(real), np.zeros(pad)].astype(np.float32))
+
+    def weighted(labels, o):
+        return jnp.dot(losses.mcxent_rows(labels, o), w) / jnp.sum(w)
+
+    v_dense = weighted(jnp.asarray(one_hot), p)
+    v_sparse = weighted(jnp.asarray(ids), p)
+    _assert_tree_bitwise(v_dense, v_sparse, "weighted loss")
+    g_dense = jax.grad(lambda o: weighted(jnp.asarray(one_hot), o))(p)
+    g_sparse = jax.grad(lambda o: weighted(jnp.asarray(ids), o))(p)
+    _assert_tree_bitwise(g_dense, g_sparse, "weighted grad")
+
+
+def test_sparse_labels_rejected_outside_mcxent_family():
+    ids = jnp.zeros(4, jnp.int32)
+    out = jnp.ones((4, 3), jnp.float32) / 3.0
+    for fn in ("mse", "xent", "squared_loss"):
+        with pytest.raises(TypeError, match="sparse"):
+            losses.get_rowwise(fn)(ids, out)
+        with pytest.raises(TypeError, match="sparse"):
+            losses.get_loss(fn)(ids, out)
+    # the mcxent family accepts them
+    losses.get_rowwise("mcxent")(ids, out)
+    losses.get_loss("negativeloglikelihood")(ids, out)
+
+
+# -- fused updater -----------------------------------------------------------
+
+def _param_tree(key):
+    """Odd, MXU-unfriendly shapes on purpose: strided slices into the flat
+    buffer are exactly where a reduction could reorder its accumulation."""
+    ks = jax.random.split(key, 4)
+    return {"blk": {"W": jax.random.normal(ks[0], (13, 7), jnp.float32),
+                    "b": jax.random.normal(ks[1], (7,), jnp.float32)},
+            "out": {"W": jax.random.normal(ks[2], (7, 5), jnp.float32),
+                    "b": jax.random.normal(ks[3], (5,), jnp.float32)}}
+
+
+_UPDATER_OPTIONS = [
+    {},
+    {"gradient_clip_norm": 0.05},          # binding clip: norms on the path
+    {"constrain_gradient_to_unit_norm": True},
+    {"use_regularization": True, "l2": 1e-3},
+    {"use_adagrad": True, "adagrad_reset_iterations": 2},
+]
+
+
+@pytest.mark.parametrize("which", ["", "sgd", "adagrad", "nesterov",
+                                   "adam", "rmsprop"])
+@pytest.mark.parametrize("opts", _UPDATER_OPTIONS,
+                         ids=[",".join(o) or "plain"
+                              for o in _UPDATER_OPTIONS])
+def test_fused_updater_bitwise(which, opts):
+    conf = NeuralNetConfiguration(lr=0.05, momentum=0.9, updater=which,
+                                  **opts)
+    params = _param_tree(jax.random.PRNGKey(10))
+    spec = make_flat_spec(params)
+    pbufs = flat_ravel(spec, params)
+    state_t = init_updater(params)
+    state_f = init_updater(pbufs)
+
+    @jax.jit
+    def both(it, grads):
+        st, tree_state = adjust_gradient(conf, it, grads, params, state_t)
+        sf, flat_state = adjust_gradient_flat(
+            conf, it, flat_ravel(spec, grads), pbufs, state_f, spec)
+        return st, tree_state, sf, flat_state
+
+    for it in range(3):  # cross the adagrad reset boundary
+        grads = jax.tree_util.tree_map(
+            lambda p: jax.random.normal(jax.random.fold_in(
+                jax.random.PRNGKey(20), it), p.shape, p.dtype) * 0.1, params)
+        st, state_t, sf, state_f = both(jnp.asarray(it), grads)
+        _assert_tree_bitwise(st, flat_unravel(spec, sf),
+                             f"{which or 'legacy'} step it={it}")
+        _assert_tree_bitwise(
+            state_t,
+            UpdaterState(
+                adagrad_hist=flat_unravel(spec, state_f.adagrad_hist),
+                velocity=flat_unravel(spec, state_f.velocity)),
+            f"{which or 'legacy'} state it={it}")
+
+
+def test_flat_norm_matches_tree_norm_bitwise():
+    params = _param_tree(jax.random.PRNGKey(11))
+    spec = make_flat_spec(params)
+    a = jax.jit(lambda t: tree_norm(t))(params)
+    b = jax.jit(lambda bufs: flat_norm(spec, bufs))(
+        flat_ravel(spec, params))
+    _assert_tree_bitwise(a, b, "global norm")
+
+
+def test_adjust_gradient_auto_dispatch_bitwise():
+    """The tree-in / tree-out fused dispatcher (what the dp train step
+    calls) must reproduce the plain path exactly when the flag is on."""
+    params = _param_tree(jax.random.PRNGKey(12))
+    grads = jax.tree_util.tree_map(lambda p: 0.3 * p, params)
+    state = init_updater(params)
+    base = NeuralNetConfiguration(lr=0.01, momentum=0.9, updater="adam",
+                                  gradient_clip_norm=0.05)
+    # jit both sides: the claim is compiled-vs-compiled (how either path
+    # runs in a train step); eager-vs-jit differs by ulps on any path
+    ref_step, ref_state = jax.jit(
+        lambda g, p, s: adjust_gradient(base, 0, g, p, s))(
+        grads, params, state)
+    fused_conf = base.replace(fused_updater=True)
+    out_step, out_state = jax.jit(
+        lambda g, p, s: adjust_gradient_auto(fused_conf, 0, g, p, s))(
+        grads, params, state)
+    _assert_tree_bitwise(ref_step, out_step, "auto step")
+    _assert_tree_bitwise(ref_state, out_state, "auto state")
+
+
+def test_flat_ravel_roundtrip_mixed_dtypes():
+    tree = {"a": jnp.arange(6, dtype=jnp.float32).reshape(2, 3),
+            "b": jnp.arange(4, dtype=jnp.bfloat16),
+            "c": jnp.arange(3, dtype=jnp.float32) * 1.5}
+    spec = make_flat_spec(tree)
+    assert spec.group_dtypes == (jnp.dtype(jnp.float32),
+                                 jnp.dtype(jnp.bfloat16))
+    assert spec.group_sizes == (9, 4)
+    _assert_tree_bitwise(tree, flat_unravel(spec, flat_ravel(spec, tree)),
+                         "roundtrip")
+
+
+# -- causal flash block-skip -------------------------------------------------
+
+@pytest.mark.parametrize("seq,blocks", [(64, (16, 16)), (96, (32, 16)),
+                                        (128, (32, 32))])
+def test_block_skip_bitwise_vs_masked_flash(seq, blocks):
+    """Skipping the mask on fully-unmasked tiles replaces a `where` by its
+    identity branch — forward AND backward must be bitwise-identical to
+    the all-masked kernel, at ragged (S, block) combinations where full
+    and partial tiles mix."""
+    bq, bk = blocks
+    kq, kk, kv = jax.random.split(jax.random.PRNGKey(4), 3)
+    B, H, D = 2, 2, 8
+    q = jax.random.normal(kq, (B, seq, H, D), jnp.float32)
+    k = jax.random.normal(kk, (B, seq, H, D), jnp.float32)
+    v = jax.random.normal(kv, (B, seq, H, D), jnp.float32)
+
+    base = flash_attention(q, k, v, True, bq, bk, block_skip=False)
+    skip = flash_attention(q, k, v, True, bq, bk, block_skip=True)
+    _assert_tree_bitwise(base, skip, f"fwd S={seq}")
+
+    g_base = jax.grad(lambda a, b, c: jnp.sum(flash_attention(
+        a, b, c, True, bq, bk, block_skip=False) ** 2),
+        argnums=(0, 1, 2))(q, k, v)
+    g_skip = jax.grad(lambda a, b, c: jnp.sum(flash_attention(
+        a, b, c, True, bq, bk, block_skip=True) ** 2),
+        argnums=(0, 1, 2))(q, k, v)
+    _assert_tree_bitwise(g_base, g_skip, f"bwd S={seq}")
+
+
+def test_block_skip_matches_full_attention():
+    kq, kk, kv = jax.random.split(jax.random.PRNGKey(5), 3)
+    B, S, H, D = 2, 64, 2, 8
+    q = jax.random.normal(kq, (B, S, H, D), jnp.float32)
+    k = jax.random.normal(kk, (B, S, H, D), jnp.float32)
+    v = jax.random.normal(kv, (B, S, H, D), jnp.float32)
+    ref = full_attention(q, k, v, causal=True)
+    out = flash_attention(q, k, v, True, 16, 16, block_skip=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_pick_attention_blocks_table_and_fallback():
+    assert pick_attention_blocks(256, 32) == (128, 128)   # table hit
+    assert pick_attention_blocks(2048, 128) == (256, 256)
+    bq, bk = pick_attention_blocks(192, 48)               # fallback: divides
+    assert 192 % bq == 0 and 192 % bk == 0
+    assert pick_attention_blocks(100, 64) == (128, 128)   # indivisible S
+
+
+# -- end-to-end through the compiled train step ------------------------------
+
+def _char_batch(vocab, batch, seq, sparse):
+    rng = np.random.RandomState(7)
+    ids = rng.randint(0, vocab, (batch, seq + 1))
+    x = jnp.asarray(ids[:, :-1].astype(np.int32))
+    if sparse:
+        return x, jnp.asarray(ids[:, 1:].reshape(-1).astype(np.int32))
+    return x, jnp.asarray(
+        np.eye(vocab, dtype=np.float32)[ids[:, 1:].reshape(-1)])
+
+
+def test_end_to_end_flag_combos_bitwise():
+    """char-transformer `finetune` through the step cache: every flag
+    combination must land on bitwise-identical parameters after the
+    solver scan (donation, bucketing and fingerprinting included)."""
+    from deeplearning4j_tpu.models.zoo import char_transformer
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+    vocab, batch, seq = 17, 4, 16
+
+    def train(fused, sparse):
+        conf = char_transformer(vocab, d_model=32, n_blocks=1, n_heads=2,
+                                max_seq_len=seq, iterations=2,
+                                fused_updater=fused, sparse_labels=sparse)
+        net = MultiLayerNetwork(conf, seed=42).init()
+        net.finetune(*_char_batch(vocab, batch, seq, sparse))
+        return net.params
+
+    ref = train(False, False)
+    for combo in [(True, False), (False, True), (True, True)]:
+        _assert_tree_bitwise(ref, train(*combo), f"combo {combo}")
+
+def _dp_train(vocab, batch, seq, steps, sparse, fused):
+    from deeplearning4j_tpu.models.zoo import char_transformer
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.parallel.data_parallel import DataParallelTrainer
+    from deeplearning4j_tpu.parallel.mesh import make_mesh
+
+    conf = char_transformer(vocab, d_model=32, n_blocks=1, n_heads=2,
+                            max_seq_len=seq, sparse_labels=sparse,
+                            fused_updater=fused)
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, vocab, size=(steps, batch, seq)).astype(np.int32)
+    net = MultiLayerNetwork(conf).init()
+    tr = DataParallelTrainer(net, mesh=make_mesh({"dp": 8}))
+    batches = []
+    for i in range(steps):
+        flat = ids[i].reshape(batch * seq)
+        y = (jnp.asarray(flat, jnp.int32) if sparse
+             else jnp.asarray(np.eye(vocab, dtype=np.float32)[flat]))
+        batches.append((jnp.asarray(ids[i]), y))
+    score = tr.fit(batches)
+    return jax.device_get(tr.state.params), score
+
+
+def test_dp_step_sparse_labels_bitwise():
+    """8-way dp train, 3 batches: `sparse_labels` is fully bitwise in the
+    dp step too — params AND reported score."""
+    ref, ref_score = _dp_train(17, 16, 16, 3, sparse=False, fused=False)
+    sp, sp_score = _dp_train(17, 16, 16, 3, sparse=True, fused=False)
+    _assert_tree_bitwise(ref, sp, "sparse_labels dp")
+    assert sp_score == ref_score
+
+
+def test_dp_step_fused_updater_single_step_bitwise():
+    """One 8-way dp step: the fused updater must land on bitwise-identical
+    params even though tree- and flat-layout steps are separately
+    compiled programs — a single application has no accumulated state for
+    fusion-level rounding to amplify."""
+    ref, ref_score = _dp_train(17, 16, 16, 1, sparse=False, fused=False)
+    for sparse, fused in [(False, True), (True, True)]:
+        got, score = _dp_train(17, 16, 16, 1, sparse=sparse, fused=fused)
+        _assert_tree_bitwise(ref, got, f"dp 1-step combo {(sparse, fused)}")
+        # the score is a mean over bitwise-identical per-row losses, but
+        # the scalar reduce can fuse in a different summation order in a
+        # reshaped program — a reporting value, not training state
+        np.testing.assert_allclose(score, ref_score, rtol=1e-6,
+                                   err_msg=f"combo {(sparse, fused)}")
+
+
+def test_dp_step_fused_updater_iterated_close():
+    """Iterated 8-way dp steps: across *separately compiled* tree- vs
+    flat-layout programs XLA may duplicate the moment updates into the
+    step fusion with different FMA contraction — a last-ulp seed the
+    barriers in `adjust_gradient` cannot pin across layouts (see
+    `adjust_gradient_auto`).  Adam's `m / (sqrt(v) + eps)` then amplifies
+    that seed to step scale on coordinates whose moments sit near zero
+    (observed: ~1e-10 absolute on weights, up to ~4e-5 on a handful of
+    bias entries after 3 steps).  So the iterated claim is closeness at
+    step-scale tolerance; the exactness claims live in the single-step
+    and solver-path tests."""
+    ref, _ = _dp_train(17, 16, 16, 3, sparse=False, fused=False)
+    for sparse, fused in [(False, True), (True, True)]:
+        got, _ = _dp_train(17, 16, 16, 3, sparse=sparse, fused=fused)
+        for a, b in zip(jax.tree_util.tree_leaves(ref),
+                        jax.tree_util.tree_leaves(got)):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-4,
+                err_msg=f"dp 3-step combo {(sparse, fused)}")
