@@ -254,15 +254,18 @@ def _char_lstm_throughput(devs, n_layers: int):
     warmup, steps = (1, 2) if SMALL else (2, 18)
     n_dev = len(devs)
     mesh = make_mesh({"dp": n_dev})
-    conf = _mixed(char_lstm(vocab, hidden=hidden, n_layers=n_layers))
+    # int char ids in, int class-id targets out (ROADMAP item 2): the
+    # embedding gather replaces the [B,S,vocab] one-hot input and
+    # sparse_labels replaces the [B*S,vocab] one-hot loss gemm
+    conf = _mixed(char_lstm(vocab, hidden=hidden, n_layers=n_layers,
+                            sparse_labels=True, embed=hidden))
     net = MultiLayerNetwork(conf, seed=0).init()
     trainer = DataParallelTrainer(net, mesh, mode="sync")
 
     rng = np.random.RandomState(0)
     ids = rng.randint(0, vocab, (batch, seq + 1))
-    x = jnp.asarray(np.eye(vocab, dtype=np.float32)[ids[:, :-1]])
-    y = jnp.asarray(np.eye(vocab, dtype=np.float32)[ids[:, 1:]]
-                    .reshape(batch * seq, vocab))
+    x = jnp.asarray(ids[:, :-1], jnp.int32)
+    y = jnp.asarray(ids[:, 1:].reshape(batch * seq), jnp.int32)
     x, y = shard_batch(mesh, (x, y), "dp")
 
     key = jax.random.PRNGKey(0)
@@ -750,6 +753,136 @@ def bench_serve(devs) -> None:
 
 
 # ---------------------------------------------------------------------------
+# serve router — closed-loop HTTP clients across {1, 2} replica processes
+# ---------------------------------------------------------------------------
+
+def bench_serve_router(devs) -> None:
+    """Closed-loop HTTP clients against the multi-replica router
+    (serving/router.py): replica subprocesses share one pre-warmed disk
+    compile cache, the router spreads /v1/predict across them, and the
+    client fleet is split between "interactive" and "batch" priority
+    classes.  Headline = 2-replica rows/s; vs_baseline = the 2-replica /
+    1-replica throughput multiple (per-priority p50/p99 go out for the
+    2-replica arm).  CPU-bound by design: the bench measures the fabric
+    (routing, coalescing, priorities), not the chip."""
+    import json as json_mod
+    import shutil
+    import signal
+    import subprocess
+    import tempfile
+    import urllib.request
+
+    from deeplearning4j_tpu.models.zoo import mlp
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.parallel import checkpoint
+
+    clients, secs, hidden = (4, 1.0, [32]) if SMALL else (16, 4.0, [256])
+    n_in = 64
+    tmp = tempfile.mkdtemp(prefix="dl4j-bench-router-")
+    try:
+        net = MultiLayerNetwork(mlp(n_in, hidden, 10), seed=0).init()
+        ckpt = os.path.join(tmp, "model")
+        cache = os.path.join(tmp, "cache")
+        checkpoint.save(ckpt, net.params, conf=net.conf)
+        env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+        shapes = f"1,{clients}"
+        subprocess.run(
+            [sys.executable, "-m", "deeplearning4j_tpu.cli", "warmup",
+             "--model", ckpt, "--compile-cache", cache, "--shapes", shapes],
+            check=True, capture_output=True, env=env)
+        rng = np.random.RandomState(0)
+        xs = [rng.rand(1, n_in).astype(np.float32).tolist()
+              for _ in range(clients)]
+
+        def closed_loop(url):
+            lat = {"interactive": [], "batch": []}
+            counts = {"rows": 0, "errors": 0}
+            start_evt = threading.Event()
+            stop_t = [0.0]
+            lock = threading.Lock()
+
+            def client(i):
+                prio = "interactive" if i % 2 == 0 else "batch"
+                body = json_mod.dumps(
+                    {"features": xs[i], "priority": prio}).encode()
+                start_evt.wait()
+                while time.perf_counter() < stop_t[0]:
+                    t0 = time.perf_counter()
+                    try:
+                        req = urllib.request.Request(
+                            url + "/v1/predict", data=body,
+                            headers={"Content-Type": "application/json"})
+                        with urllib.request.urlopen(req, timeout=30) as r:
+                            r.read()
+                        dt = time.perf_counter() - t0
+                        with lock:
+                            lat[prio].append(dt)
+                            counts["rows"] += 1
+                    except Exception:
+                        with lock:
+                            counts["errors"] += 1
+
+            threads = [threading.Thread(target=client, args=(i,))
+                       for i in range(clients)]
+            for t in threads:
+                t.start()
+            t_begin = time.perf_counter()
+            stop_t[0] = t_begin + secs
+            start_evt.set()
+            for t in threads:
+                t.join()
+            dt = time.perf_counter() - t_begin
+
+            def pct(vals, q):
+                vals = sorted(vals)
+                if not vals:
+                    return 0.0
+                return vals[min(len(vals) - 1,
+                                int(q * (len(vals) - 1)))] * 1e3
+
+            return (counts["rows"] / dt, counts["errors"], {
+                p: {"p50_ms": round(pct(v, 0.50), 2),
+                    "p99_ms": round(pct(v, 0.99), 2)}
+                for p, v in lat.items()})
+
+        results = {}
+        for n_replicas in (1, 2):
+            proc = subprocess.Popen(
+                [sys.executable, "-m", "deeplearning4j_tpu.cli", "serve",
+                 "--model", ckpt, "--compile-cache", cache,
+                 "--shapes", shapes, "--replicas", str(n_replicas),
+                 "--max-delay-ms", "2", "--drain-timeout", "10"],
+                stdout=subprocess.PIPE, text=True, env=env)
+            try:
+                summary = json_mod.loads(proc.stdout.readline())
+                results[n_replicas] = closed_loop(summary["url"]) + (
+                    summary["fresh_compiles"],)
+            finally:
+                proc.send_signal(signal.SIGTERM)
+                try:
+                    proc.communicate(timeout=60)
+                except subprocess.TimeoutExpired:
+                    proc.kill()
+                    proc.communicate()
+
+        one_rows_s = results[1][0]
+        two_rows_s, two_errors, two_lat, two_fresh = results[2]
+        _emit("serve router 2-replica rows/sec", two_rows_s, "rows/sec",
+              two_rows_s / max(one_rows_s, 1e-9),
+              clients=clients,
+              rows_per_sec_1replica=round(one_rows_s, 1),
+              errors_2replica=two_errors,
+              latency_interactive=two_lat["interactive"],
+              latency_batch=two_lat["batch"],
+              fresh_compiles_per_replica=two_fresh,
+              baseline_note="vs_baseline = rows/s multiple vs a 1-replica "
+                            "router, same closed-loop client fleet, shared "
+                            "warmed disk compile cache")
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+# ---------------------------------------------------------------------------
 # prefetch — LeNet mini-batch fit with the async device_put pipeline on/off
 # ---------------------------------------------------------------------------
 
@@ -940,8 +1073,8 @@ def bench_cold_start(devs) -> None:
 BENCHES = [bench_lenet, bench_char_lstm, bench_vgg_cifar10, bench_word2vec,
            bench_dp_allreduce,
            bench_char_lstm4, bench_step_cache, bench_infer_latency,
-           bench_serve, bench_prefetch, bench_cold_start,
-           bench_north_star_cli, bench_transformer_mfu]
+           bench_serve, bench_serve_router, bench_prefetch,
+           bench_cold_start, bench_north_star_cli, bench_transformer_mfu]
 BASELINE_FIVE = {"bench_lenet", "bench_char_lstm", "bench_vgg_cifar10",
                  "bench_word2vec", "bench_dp_allreduce"}
 

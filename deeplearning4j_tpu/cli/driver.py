@@ -427,6 +427,10 @@ def _build_server(args):
     start the gateway.  Returns (net, server, startup-summary dict)."""
     net = _load_model(args.model)
     _attach_compile_cache(net, args)
+    mesh_devices = None
+    if getattr(args, "mesh", False):
+        # before warmup, so the warmed programs carry the mesh cache key
+        mesh_devices = int(net.set_serve_mesh().devices.size)
     shapes = _parse_shapes(args.shapes)
     warmed = None
     if shapes:
@@ -448,6 +452,7 @@ def _build_server(args):
     summary = {"url": server.url, "warmed": warmed,
                "fresh_compiles": net.infer_cache.stats.misses,
                "batching": not args.no_batching,
+               "mesh_devices": mesh_devices,
                "disk_cache": _disk_stats(net)}
     return net, server, summary
 
@@ -455,6 +460,8 @@ def _build_server(args):
 def cmd_serve(args) -> int:
     import signal
 
+    if getattr(args, "replicas", 0) >= 1:
+        return cmd_serve_router(args)
     _, server, summary = _build_server(args)
     print(json.dumps(summary), flush=True)
     # SIGTERM/SIGINT → graceful drain: the handler only flips an event
@@ -481,6 +488,131 @@ def cmd_serve(args) -> int:
                           "deadline_misses": st.get("deadline_misses", 0),
                           "errors": st.get("errors", 0)}), flush=True)
     return 0
+
+
+def _replica_cmd(args) -> List[str]:
+    """The `serve` command line one replica subprocess runs: the
+    caller's flags minus --replicas, always on an ephemeral port."""
+    cmd = [sys.executable, "-m", "deeplearning4j_tpu.cli", "serve",
+           "--model", args.model, "--host", args.host, "--port", "0",
+           "--shapes", args.shapes,
+           "--max-delay-ms", str(args.max_delay_ms),
+           "--max-pending", str(args.max_pending),
+           "--drain-timeout", str(getattr(args, "drain_timeout", 10.0)),
+           "--request-timeout", str(getattr(args, "request_timeout", 30.0))]
+    if args.compile_cache:
+        cmd += ["--compile-cache", args.compile_cache]
+    if args.max_batch_rows is not None:
+        cmd += ["--max-batch-rows", str(args.max_batch_rows)]
+    if args.no_batching:
+        cmd += ["--no-batching"]
+    if getattr(args, "default_deadline_ms", None) is not None:
+        cmd += ["--default-deadline-ms", str(args.default_deadline_ms)]
+    if getattr(args, "mesh", False):
+        cmd += ["--mesh"]
+    return cmd
+
+
+class ReplicaProcess:
+    """One `serve` replica subprocess: spawn, read the startup JSON off
+    its stdout (blocks until the replica warmed and is listening),
+    SIGTERM + collect the drained JSON at shutdown."""
+
+    def __init__(self, cmd: List[str]):
+        import subprocess
+
+        self.proc = subprocess.Popen(cmd, stdout=subprocess.PIPE, text=True)
+        self.summary: Optional[dict] = None
+
+    def wait_ready(self) -> dict:
+        line = self.proc.stdout.readline()
+        if not line:
+            rc = self.proc.wait()
+            raise SystemExit(f"replica died during startup (exit {rc})")
+        self.summary = json.loads(line)
+        return self.summary
+
+    @property
+    def url(self) -> Optional[str]:
+        return None if self.summary is None else self.summary.get("url")
+
+    def terminate(self) -> None:
+        import signal
+
+        if self.proc.poll() is None:
+            try:
+                self.proc.send_signal(signal.SIGTERM)
+            except OSError:
+                pass
+
+    def wait(self, timeout: Optional[float] = None) -> int:
+        try:
+            rc = self.proc.wait(timeout)
+        finally:
+            if self.proc.stdout is not None:
+                self.proc.stdout.close()
+        return rc
+
+
+def cmd_serve_router(args) -> int:
+    """serve --replicas N: spawn N replica subprocesses sharing the
+    --compile-cache dir, front them with `serving.Router`, mirror the
+    single-server SIGTERM contract fleet-wide — drain the ROUTER first
+    (every accepted request still finds its replica), then SIGTERM the
+    replicas and insist they all drain to exit 0."""
+    import signal
+
+    from deeplearning4j_tpu.serving.router import Router
+
+    cmd = _replica_cmd(args)
+    replicas = [ReplicaProcess(cmd) for _ in range(args.replicas)]
+    router = None
+    try:
+        summaries = [r.wait_ready() for r in replicas]
+        router = Router([s["url"] for s in summaries],
+                        host=args.host, port=args.port,
+                        request_timeout_s=getattr(args, "request_timeout",
+                                                  30.0) + 5.0).start()
+        print(json.dumps({
+            "url": router.url,
+            "replicas": [s["url"] for s in summaries],
+            "fresh_compiles": [s.get("fresh_compiles") for s in summaries],
+            "mesh_devices": summaries[0].get("mesh_devices"),
+        }), flush=True)
+        prev = {}
+        for sig in (signal.SIGTERM, signal.SIGINT):
+            try:
+                prev[sig] = signal.signal(
+                    sig, lambda signum, frame: router.request_stop())
+            except ValueError:
+                pass  # not the main thread: explicit stop only
+        try:
+            router.wait_for_stop()
+        except KeyboardInterrupt:
+            pass
+        finally:
+            for sig, handler in prev.items():
+                signal.signal(sig, handler)
+    finally:
+        drain_timeout = getattr(args, "drain_timeout", 10.0)
+        if router is not None:
+            router.drain(drain_timeout)
+        for r in replicas:
+            r.terminate()
+        rcs = []
+        for r in replicas:
+            try:
+                rcs.append(r.wait(timeout=drain_timeout + 15.0))
+            except Exception:  # noqa: BLE001 — a wedged replica: kill
+                r.proc.kill()
+                rcs.append(r.wait())
+        stats = router.stats() if router is not None else {}
+        print(json.dumps({"drained": True,
+                          "replica_exit_codes": rcs,
+                          "retries": stats.get("retries", 0),
+                          "unroutable": stats.get("unroutable", 0)}),
+              flush=True)
+    return 0 if rcs and all(rc == 0 for rc in rcs) else 1
 
 
 def _add_common(p: argparse.ArgumentParser) -> None:
@@ -607,6 +739,16 @@ def build_parser() -> argparse.ArgumentParser:
                    help="deadline applied to requests that carry no "
                         "deadline_ms of their own; expired requests are "
                         "evicted before padding and answered 504")
+    s.add_argument("--replicas", type=int, default=0, metavar="N",
+                   help="front N replica subprocesses (each its own "
+                        "gateway, all sharing --compile-cache) with the "
+                        "routing front end; 0 (default) serves in-process "
+                        "with no router")
+    s.add_argument("--mesh", action="store_true",
+                   help="shard each coalesced batch's rows across every "
+                        "visible device (Mesh(('batch',)), params "
+                        "replicated); bitwise-identical outputs, one "
+                        "program per sharding in the compile cache")
     s.set_defaults(fn=cmd_serve)
     return ap
 

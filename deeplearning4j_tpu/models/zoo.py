@@ -157,18 +157,28 @@ def fit_deep_autoencoder(net, x):
 
 def char_lstm(vocab: int, hidden: int = 256, n_layers: int = 1,
               lr: float = 0.1, iterations: int = 1,
-              sparse_labels: bool = False) -> MultiLayerConfiguration:
+              sparse_labels: bool = False,
+              embed: int = 0) -> MultiLayerConfiguration:
     """char-LSTM (BASELINE configs[1]; reference `LSTM.java:53` is a
     1-layer karpathy char-LSTM with fused iFog gates + decoder).
 
     `sparse_labels=True` declares that training feeds int class-id targets
     (shape [batch*seq]) instead of one-hot rows — the mcxent gather path,
-    bitwise-identical loss without the [rows, vocab] one-hot gemm."""
+    bitwise-identical loss without the [rows, vocab] one-hot gemm.
+
+    `embed > 0` prepends an EMBEDDING layer (vocab -> embed, no positional
+    table — the LSTM carries order) so the net consumes int char ids
+    [batch, seq] directly: the input one-hot [B, S, vocab] materialization
+    and its gemm against the first LSTM's W become a table gather."""
     b = _base(lr=lr, iters=iterations)
     confs = []
+    if embed > 0:
+        confs.append(b.replace(layer_type=LayerType.EMBEDDING, n_in=vocab,
+                               n_out=embed))
     for i in range(n_layers):
         confs.append(b.replace(layer_type=LayerType.LSTM,
-                               n_in=vocab if i == 0 else hidden,
+                               n_in=(embed if embed > 0 else vocab)
+                               if i == 0 else hidden,
                                n_out=hidden,
                                activation=Activation.TANH))
     confs.append(b.replace(layer_type=LayerType.OUTPUT, n_in=hidden,
@@ -178,7 +188,7 @@ def char_lstm(vocab: int, hidden: int = 256, n_layers: int = 1,
     return MultiLayerConfiguration(
         confs=tuple(confs), backprop=True,
         # output layer consumes per-timestep features
-        input_preprocessors=((n_layers, "rnn_to_ff"),))
+        input_preprocessors=((len(confs) - 1, "rnn_to_ff"),))
 
 
 def vgg_cifar10(lr: float = 0.05, iterations: int = 1,

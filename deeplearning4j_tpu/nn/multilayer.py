@@ -376,6 +376,22 @@ class MultiLayerNetwork:
         self.infer_cache.set_persist(store)
         return store
 
+    def set_serve_mesh(self, mesh=None):
+        """Shard serve-path batches across a `Mesh(('batch',))` — rows
+        split over the mesh, params replicated, collectives inserted by
+        jit (the GSPMD pattern).  `mesh=None` (no argument) builds
+        `parallel.mesh.serve_mesh()` over every visible device; pass an
+        explicit mesh to use a subset.  Sharding is a cache-KEY
+        dimension, so single-chip and mesh programs coexist in memory
+        and on disk; outputs stay bitwise-identical either way (rows are
+        independent).  Returns the mesh."""
+        from deeplearning4j_tpu.parallel.mesh import serve_mesh
+
+        if mesh is None:
+            mesh = serve_mesh()
+        self.infer_cache.set_mesh(mesh)
+        return mesh
+
     def warmup(self, shapes, entries=("output",), train=False):
         """Precompile the serve/train programs for the given batch shapes
         ahead of traffic, so the first real request is a cache hit.

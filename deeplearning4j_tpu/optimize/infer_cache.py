@@ -10,7 +10,8 @@ the dominant non-compute overhead of accelerator inference.
 `InferCache` reuses the `CompiledProgramCache` machinery:
 
   key schema    (entry point in {output, loss, feed_forward},
-                 conf fingerprint, arg shapes/dtypes) -> AOT executable.
+                 conf fingerprint, arg shapes/dtypes, sharding tag)
+                 -> AOT executable.
   batch args    (params, x[, y, w]) are explicit jit arguments — params
                  can keep training between serve calls without retraces.
   bucketing     ragged final batches zero-pad up to the smallest known
@@ -21,6 +22,16 @@ the dominant non-compute overhead of accelerator inference.
                  training (`dot(rows, w)` is bit-invariant to trailing
                  zero-weight rows) — padded evaluation matches unpadded
                  evaluation bit-for-bit in f32.
+  mesh sharding `set_mesh(Mesh(('batch',)))` shards the padded batch's
+                 rows across the mesh with params replicated (the GSPMD
+                 pattern: jit inserts the collectives, the same code
+                 runs on 1 chip or a pod).  The sharding is a KEY
+                 dimension, so single-chip and mesh programs for the
+                 same (entry, fingerprint, bucket) coexist in memory and
+                 in the disk cache; buckets round up to a multiple of
+                 the mesh size so every shard gets equal rows.  Row
+                 independence makes mesh outputs bitwise-identical to
+                 the single-chip program's.
   no donation   unlike the train cache, inference programs NEVER donate
                  their params buffer: the same params serve every call.
   observability `cache.stats` (hits / misses / steps / compile seconds)
@@ -30,8 +41,9 @@ the dominant non-compute overhead of accelerator inference.
 
 from __future__ import annotations
 
-from typing import Callable, Tuple
+from typing import Callable, Optional, Tuple
 
+import jax
 import jax.numpy as jnp
 
 from deeplearning4j_tpu.optimize.step_cache import (CompiledProgramCache,
@@ -64,10 +76,101 @@ class InferCache(CompiledProgramCache):
 
     kind = "infer-cache"
 
+    #: key element for programs compiled without a mesh
+    SINGLE = "single"
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        self._mesh = None
+        self._replicated = None       # params sharding under the mesh
+        self._batch_sharding = None   # row sharding under the mesh
+        # memoized replicated placement of the last-served params tree
+        # (holds the original tree so identity can't be recycled)
+        self._placed_params: Tuple = (None, None)
+
     def _donate_argnums(self) -> Tuple[int, ...]:
         # serve-path params are reused by every subsequent call (and by
         # training) — donation would invalidate live buffers
         return ()
+
+    # -- mesh ----------------------------------------------------------------
+    def set_mesh(self, mesh) -> None:
+        """Shard every subsequent serve call's rows across `mesh`
+        (`Mesh(('batch',))`, params replicated — `parallel.mesh.
+        serve_mesh()` builds it); None reverts to single-chip programs.
+        Already-compiled programs stay cached under their own sharding
+        tag, so flipping back and forth never evicts or recompiles."""
+        from deeplearning4j_tpu.parallel.mesh import infer_shardings
+
+        with self._lock:
+            self._mesh = mesh
+            self._placed_params = (None, None)
+            if mesh is None:
+                self._replicated = self._batch_sharding = None
+            else:
+                self._replicated, self._batch_sharding = infer_shardings(mesh)
+
+    @property
+    def mesh(self):
+        return self._mesh
+
+    def _mesh_rows(self) -> int:
+        """Row-divisibility the current sharding demands (1 = no mesh)."""
+        return 1 if self._mesh is None else int(self._mesh.devices.size)
+
+    def sharding_tag(self):
+        """The sharding dimension of the cache key: 'single' or a
+        (mesh, axis names, mesh shape) tuple.  Distinct tags can never
+        alias — single-chip and mesh programs coexist."""
+        if self._mesh is None:
+            return self.SINGLE
+        return ("mesh", tuple(self._mesh.axis_names),
+                tuple(int(d) for d in self._mesh.devices.shape))
+
+    def _serve_bucket(self, n: int) -> int:
+        """Bucket for `n` rows.  Under a mesh the bucket must divide
+        evenly across the 'batch' axis, so pick the smallest known
+        divisible bucket >= n, else grow a new one at the next multiple
+        (single-chip buckets stay visible to mesh calls only when they
+        happen to divide — no eviction, just separate buckets)."""
+        m = self._mesh_rows()
+        if m == 1:
+            return self.bucket_rows(n)
+        target = -(-n // m) * m
+        with self._lock:
+            for b in self._buckets:
+                if b >= n and b % m == 0:
+                    return b
+            if not self._fixed_buckets:
+                self._buckets.append(target)
+                self._buckets.sort()
+            return target
+
+    def _shardings(self, n_batch_args: int) -> Optional[Tuple]:
+        """(params sharding, batch shardings...) under the mesh; None
+        single-chip."""
+        if self._mesh is None:
+            return None
+        return (self._replicated,) + (self._batch_sharding,) * n_batch_args
+
+    def _place(self, params, *batch_args) -> Tuple:
+        """Device placement for execution under the mesh: params
+        replicated once per tree (memoized — serving reuses one tree for
+        every request), batch args row-sharded."""
+        if self._mesh is None:
+            return (params,) + batch_args
+        with self._lock:
+            held, placed = self._placed_params
+            if held is params:
+                params_placed = placed
+            else:
+                params_placed = None
+        if params_placed is None:
+            params_placed = jax.device_put(params, self._replicated)
+            with self._lock:
+                self._placed_params = (params, params_placed)
+        return (params_placed,) + tuple(
+            jax.device_put(a, self._batch_sharding) for a in batch_args)
 
     # -- entry points -------------------------------------------------------
     def output(self, conf, params, x, compile_only: bool = False):
@@ -76,31 +179,34 @@ class InferCache(CompiledProgramCache):
         (warmup) registers the bucket and compiles — or disk-restores —
         the program without executing it."""
         n = int(x.shape[0])
-        bucket = self.bucket_rows(n)
+        bucket = self._serve_bucket(n)
         xp = pad_rows(x, bucket)
-        key = ("output", self._fingerprint(conf), arg_signature(xp))
-        args = (params, xp)
-        fn = self._get(key, lambda: _output_program(conf), args)
+        key = ("output", self._fingerprint(conf), arg_signature(xp),
+               self.sharding_tag())
+        fn = self._get(key, lambda: _output_program(conf), (params, xp),
+                       shardings=self._shardings(1))
         if compile_only:
             return None
         with self._lock:
             self.stats.steps += 1
-        return truncate_rows(fn(*args), bucket, n)
+        return truncate_rows(fn(*self._place(params, xp)), bucket, n)
 
     def feed_forward(self, conf, params, x, compile_only: bool = False):
         """`feed_forward` through the cache: the per-layer activation
         list, each sliced back to the real rows."""
         n = int(x.shape[0])
-        bucket = self.bucket_rows(n)
+        bucket = self._serve_bucket(n)
         xp = pad_rows(x, bucket)
-        key = ("feed_forward", self._fingerprint(conf), arg_signature(xp))
-        args = (params, xp)
-        fn = self._get(key, lambda: _feed_forward_program(conf), args)
+        key = ("feed_forward", self._fingerprint(conf), arg_signature(xp),
+               self.sharding_tag())
+        fn = self._get(key, lambda: _feed_forward_program(conf), (params, xp),
+                       shardings=self._shardings(1))
         if compile_only:
             return None
         with self._lock:
             self.stats.steps += 1
-        return [truncate_rows(a, bucket, n) for a in fn(*args)]
+        return [truncate_rows(a, bucket, n)
+                for a in fn(*self._place(params, xp))]
 
     def loss(self, conf, params, x, y, compile_only: bool = False):
         """`network_loss(training=False)` through the cache: the
@@ -108,16 +214,17 @@ class InferCache(CompiledProgramCache):
         Pad rows carry weight 0 and the mean is a gemm contraction, so a
         bucket-padded tail scores bit-identically to the unpadded batch."""
         n = int(x.shape[0])
-        bucket = self.bucket_rows(n)
+        bucket = self._serve_bucket(n)
         xp, yp, w = self.pad_batch(x, y, bucket)
-        key = ("loss", self._fingerprint(conf), arg_signature(xp, yp, w))
-        args = (params, xp, yp, w)
-        fn = self._get(key, lambda: _loss_program(conf), args)
+        key = ("loss", self._fingerprint(conf), arg_signature(xp, yp, w),
+               self.sharding_tag())
+        fn = self._get(key, lambda: _loss_program(conf), (params, xp, yp, w),
+                       shardings=self._shardings(3))
         if compile_only:
             return None
         with self._lock:
             self.stats.steps += 1
-        return fn(*args)
+        return fn(*self._place(params, xp, yp, w))
 
 
 def _output_program(conf) -> Callable:

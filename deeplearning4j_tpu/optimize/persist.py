@@ -39,6 +39,12 @@ Design:
   bounded size   a size-capped LRU keeps the directory under
                  `max_bytes`: loads touch the file's mtime, writes evict
                  oldest-read entries until the total fits.
+  multi-writer   several processes (serving replicas) share one
+                 directory with no coordination: every path between
+                 listdir/stat and open/remove tolerates the entry
+                 vanishing under a sibling's eviction — a vanished file
+                 is a counted miss (`vanished`), never an exception, and
+                 double-evictions count once.
 
 The store is shared by `TrainStepCache` and `InferCache` (one key
 schema, one export format); see `MultiLayerNetwork.set_compile_cache`
@@ -125,6 +131,7 @@ class PersistentProgramStore:
         self.evictions = 0
         self.corrupt_evicted = 0
         self.io_errors = 0       # OSErrors downgraded to cache misses
+        self.vanished = 0        # entries a sibling process removed first
         self._io_warned = False  # warn ONCE, then count quietly
 
     def _note_io_error(self, op: str, path: str, exc: BaseException) -> None:
@@ -182,10 +189,14 @@ class PersistentProgramStore:
 
             exported = jax_export.deserialize(bytearray(blob))
         except Exception as e:  # noqa: BLE001 — any bad entry: evict
-            self.corrupt_evicted += 1
-            log.warning("compile-cache: evicting bad entry %s (%s)",
-                        os.path.basename(path), e)
-            self._remove(path)
+            if self._remove(path):
+                self.corrupt_evicted += 1
+                log.warning("compile-cache: evicting bad entry %s (%s)",
+                            os.path.basename(path), e)
+            else:
+                # a sibling replica evicted (or rewrote) it between our
+                # read and remove — their problem resolved it; plain miss
+                self.vanished += 1
             return None
         # LRU touch: loads refresh recency so hot serve-path entries
         # outlive cold ones under the size cap
@@ -266,7 +277,13 @@ class PersistentProgramStore:
     def _enforce_cap(self, keep: Optional[str] = None) -> None:
         """Evict least-recently-used entries until the directory fits
         `max_bytes`.  The just-written entry (`keep`) is preferred even
-        if it alone exceeds the cap — an empty cache is strictly worse."""
+        if it alone exceeds the cap — an empty cache is strictly worse.
+
+        Concurrency: `entries` is a snapshot; a sibling replica may have
+        evicted any of them already.  Either way the bytes are gone from
+        the directory, so the freed size counts toward the cap, but only
+        an ACTUAL removal counts as our eviction — a lost race is
+        `vanished`, so two replicas never double-count one entry."""
         entries = self._entries()
         total = sum(size for _, size, _ in entries)
         if total <= self.max_bytes:
@@ -276,18 +293,25 @@ class PersistentProgramStore:
                 break
             if p == keep:
                 continue
-            self._remove(p)
-            self.evictions += 1
+            if self._remove(p):
+                self.evictions += 1
+                log.info("compile-cache: LRU-evicted %s (%d bytes)",
+                         os.path.basename(p), size)
+            else:
+                self.vanished += 1
             total -= size
-            log.info("compile-cache: LRU-evicted %s (%d bytes)",
-                     os.path.basename(p), size)
 
     @staticmethod
-    def _remove(path: str) -> None:
+    def _remove(path: str) -> bool:
+        """Best-effort unlink; True iff THIS process removed the file
+        (False: already gone — typically a sibling replica's eviction)."""
         try:
             os.remove(path)
+            return True
+        except FileNotFoundError:
+            return False
         except OSError:
-            pass
+            return False
 
     def __len__(self):
         return len(self._entries())

@@ -203,24 +203,39 @@ class CompiledProgramCache:
             donate = jax.default_backend() != "cpu"
         return (0,) if donate else ()
 
-    def _get(self, key: Tuple, build: Callable[[], Callable], args: Tuple):
+    def _get(self, key: Tuple, build: Callable[[], Callable], args: Tuple,
+             shardings: Optional[Tuple] = None):
         """Return the compiled executable for `key`: memory hit, else
         disk hit (persistent store attached), else a timed fresh
         trace+compile with disk write-back.  Serialized under the cache
         lock: two threads racing a miss would otherwise compile (and
-        persist) the same program twice."""
+        persist) the same program twice.
+
+        shardings: optional per-arg `jax.sharding.Sharding`s (None =
+        default single-device placement).  Each entry is applied to every
+        leaf of the matching arg subtree, so a mesh-sharded program
+        (replicated params, row-sharded batch) compiles with jit-inserted
+        collectives — the caller must fold the sharding into `key`."""
         with self._lock:
-            return self._get_locked(key, build, args)
+            return self._get_locked(key, build, args, shardings)
 
     def _get_locked(self, key: Tuple, build: Callable[[], Callable],
-                    args: Tuple):
+                    args: Tuple, shardings: Optional[Tuple] = None):
         fn = self._programs.get(key)
         if fn is not None:
             self.stats.hits += 1
             return fn
-        abstract = jax.tree_util.tree_map(
-            lambda a: jax.ShapeDtypeStruct(jnp.shape(a),
-                                           jnp.asarray(a).dtype), args)
+        if shardings is None:
+            abstract = jax.tree_util.tree_map(
+                lambda a: jax.ShapeDtypeStruct(jnp.shape(a),
+                                               jnp.asarray(a).dtype), args)
+        else:
+            abstract = tuple(
+                jax.tree_util.tree_map(
+                    lambda a, _s=s: jax.ShapeDtypeStruct(
+                        jnp.shape(a), jnp.asarray(a).dtype, sharding=_s),
+                    arg)
+                for arg, s in zip(args, shardings))
         donate = self._donate_argnums()
         if self._persist is not None:
             fn = self._load_from_disk(key, abstract, donate)

@@ -77,3 +77,27 @@ def shard_batch(mesh: Mesh, arrays, axis: str = "dp"):
     slots / Spark broadcast, here a single `device_put`)."""
     sh = batch_sharding(mesh, axis)
     return jax.tree_util.tree_map(lambda a: jax.device_put(a, sh), arrays)
+
+
+# -- serving ----------------------------------------------------------------
+# Inference wants exactly one mesh axis: rows of the coalesced batch
+# spread over every chip, params replicated (the GSPMD pattern — jit
+# inserts the collectives, the same program scales from one chip to a
+# pod without code changes).
+
+SERVE_AXIS = "batch"
+
+
+def serve_mesh(devices=None) -> Mesh:
+    """1-D `Mesh(('batch',))` over `devices` (default: all visible) for
+    mesh-sharded inference.  On a single-device host this degrades to a
+    mesh of 1 — same program, no collectives."""
+    if devices is None:
+        devices = jax.devices()
+    return Mesh(np.asarray(devices).reshape(-1), (SERVE_AXIS,))
+
+
+def infer_shardings(mesh: Mesh):
+    """(replicated params sharding, row-sharded batch sharding) for an
+    inference mesh — the two placements every serve-path program uses."""
+    return NamedSharding(mesh, P()), NamedSharding(mesh, P(SERVE_AXIS))

@@ -23,6 +23,10 @@ class CircuitBreaker:
     OPEN = "open"
     HALF_OPEN = "half_open"
 
+    #: numeric encoding for metrics exporters (Prometheus gauges carry
+    #: floats, not strings): closed=0, open=1, half_open=2
+    STATE_CODES = {CLOSED: 0, OPEN: 1, HALF_OPEN: 2}
+
     def __init__(self, failure_threshold: int = 5,
                  reset_timeout_s: float = 5.0, probe_prob: float = 0.5,
                  clock=time.monotonic, rng=None):
@@ -54,6 +58,11 @@ class CircuitBreaker:
                 and self._clock() - self._opened_at >= self.reset_timeout_s):
             self._state = self.HALF_OPEN
         return self._state
+
+    @property
+    def state_code(self) -> int:
+        """`STATE_CODES[self.state]` — the gauge value for /metrics."""
+        return self.STATE_CODES[self.state]
 
     def allow(self) -> bool:
         """May this call try the primary path?  CLOSED: always.

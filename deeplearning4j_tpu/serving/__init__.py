@@ -1,17 +1,30 @@
-"""Dynamic micro-batching serving gateway (ISSUE 4 tentpole).
+"""Serving fabric: micro-batching gateway, replica router, metrics.
 
 Turns many concurrent single-row (or small-batch) predict requests into
 one bucketed device call over the serve-path AOT compile cache
-(`optimize/infer_cache.py`): `MicroBatcher` coalesces, `ModelServer`
-exposes it over HTTP.  Hardened by the resilience layer (ISSUE 5):
-per-request deadlines, a circuit breaker with eager degraded mode,
-health/readiness endpoints, and bounded graceful drain.
+(`optimize/infer_cache.py`): `MicroBatcher` coalesces (with priority
+classes — interactive preempts batch), `ModelServer` exposes one
+replica over HTTP, `Router` spreads `/v1/predict` across N replica
+processes sharing one warmed disk compile cache, and
+`serving.metrics` exports the whole fleet's counters in Prometheus
+text format at `/metrics`.  Hardened by the resilience layer
+(ISSUE 5): per-request deadlines, circuit breakers with eager degraded
+mode, health/readiness endpoints, and bounded graceful drain —
+router-first, then replicas.
 """
 
 from deeplearning4j_tpu.reliability import CircuitBreaker, DeadlineExceeded
-from deeplearning4j_tpu.serving.batcher import (MicroBatcher,
+from deeplearning4j_tpu.serving.batcher import (LATENCY_BUCKETS_S,
+                                                PRIORITIES, MicroBatcher,
                                                 ServerOverloaded)
+from deeplearning4j_tpu.serving.metrics import (CONTENT_TYPE,
+                                                parse_prometheus_text,
+                                                replica_metrics,
+                                                router_metrics)
+from deeplearning4j_tpu.serving.router import Replica, Router
 from deeplearning4j_tpu.serving.server import ModelServer, ServerDraining
 
-__all__ = ["CircuitBreaker", "DeadlineExceeded", "MicroBatcher",
-           "ModelServer", "ServerDraining", "ServerOverloaded"]
+__all__ = ["CONTENT_TYPE", "CircuitBreaker", "DeadlineExceeded",
+           "LATENCY_BUCKETS_S", "MicroBatcher", "ModelServer", "PRIORITIES",
+           "Replica", "Router", "ServerDraining", "ServerOverloaded",
+           "parse_prometheus_text", "replica_metrics", "router_metrics"]

@@ -56,6 +56,18 @@ DEFAULT_TARGET_ROWS = 256
 #: rows/s is reported over this trailing window (seconds)
 RATE_WINDOW_S = 10.0
 
+#: request priority classes, highest first.  "interactive" (the default:
+#: a user is waiting) preempts "batch" (offline scoring backfill) in the
+#: coalescing queue — each queue stays partitioned interactive-prefix /
+#: batch-suffix, so when a flush can't take everyone the user-facing
+#: rows ride first.
+PRIORITIES = ("interactive", "batch")
+
+#: cumulative-histogram bucket bounds (seconds) for per-priority request
+#: latency — Prometheus-convention `le` upper bounds, +Inf implied
+LATENCY_BUCKETS_S = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+                     0.25, 0.5, 1.0, 2.5, 5.0, 10.0)
+
 
 class ServerOverloaded(RuntimeError):
     """The gateway's pending queue is full — fail fast (HTTP 503)."""
@@ -65,9 +77,10 @@ class _Pending:
     """One enqueued request: its rows, completion event, and timing."""
 
     __slots__ = ("x", "rows", "done", "result", "error", "t_enqueue",
-                 "deadline")
+                 "deadline", "priority")
 
-    def __init__(self, x, deadline_ms: Optional[float] = None):
+    def __init__(self, x, deadline_ms: Optional[float] = None,
+                 priority: str = "interactive"):
         self.x = x
         self.rows = int(x.shape[0])
         self.done = threading.Event()
@@ -76,6 +89,7 @@ class _Pending:
         self.t_enqueue = time.monotonic()
         self.deadline = (None if deadline_ms is None
                          else self.t_enqueue + float(deadline_ms) / 1000.0)
+        self.priority = priority
 
 
 class MicroBatcher:
@@ -124,6 +138,16 @@ class MicroBatcher:
         self._deadline_misses = 0   # requests evicted past their deadline
         self._errors = 0            # requests answered with an exception
         self._degraded_batches = 0  # batches served by the eager fallback
+        # -- per-priority-class stats (guarded by _cv's lock) --------------
+        self._pending_by = {p: 0 for p in PRIORITIES}
+        self._reqs_by = {p: 0 for p in PRIORITIES}       # completions
+        self._lat_by = {p: deque(maxlen=4096) for p in PRIORITIES}
+        # cumulative latency histogram per priority: one count per
+        # LATENCY_BUCKETS_S bound (non-cumulative here; exporters sum),
+        # +Inf bucket == count
+        self._lat_hist = {p: {"counts": [0] * len(LATENCY_BUCKETS_S),
+                              "inf": 0, "sum": 0.0, "count": 0}
+                          for p in PRIORITIES}
 
     # -- lifecycle ----------------------------------------------------------
     def start(self) -> "MicroBatcher":
@@ -149,9 +173,15 @@ class MicroBatcher:
 
     # -- request side (any thread) ------------------------------------------
     def predict(self, x, timeout: Optional[float] = None,
-                deadline_ms: Optional[float] = None) -> np.ndarray:
+                deadline_ms: Optional[float] = None,
+                priority: str = "interactive") -> np.ndarray:
         """Enqueue `x` ([rows, ...features]) and block until its output
         activations come back from a coalesced device call.
+
+        `priority` is one of `PRIORITIES`: "interactive" requests are
+        inserted ahead of every queued "batch" request (behind earlier
+        interactive ones), so batch backfill can never hold a user
+        request behind a long tail of queued offline rows.
 
         Raises `ServerOverloaded` when `max_pending` requests are
         already queued, `DeadlineExceeded` when `deadline_ms` elapses
@@ -162,20 +192,35 @@ class MicroBatcher:
             raise ValueError(
                 f"predict expects batched input [rows, ...features]; "
                 f"got shape {x.shape}")
+        if priority not in PRIORITIES:
+            raise ValueError(
+                f"priority must be one of {PRIORITIES}; got {priority!r}")
         if deadline_ms is not None and float(deadline_ms) <= 0.0:
             with self._cv:
                 self._deadline_misses += 1
+                self._reqs_by[priority] += 1
             raise DeadlineExceeded(
                 f"deadline_ms={deadline_ms} already expired at enqueue")
-        req = _Pending(x, deadline_ms)
+        req = _Pending(x, deadline_ms, priority)
         key = (x.shape[1:], str(x.dtype))
         with self._cv:
             if self._pending >= self.max_pending:
                 raise ServerOverloaded(
                     f"{self._pending} requests already pending "
                     f"(max_pending={self.max_pending})")
-            self._queues.setdefault(key, deque()).append(req)
+            q = self._queues.setdefault(key, deque())
+            if priority == "batch" or not q or q[-1].priority != "batch":
+                q.append(req)
+            else:
+                # interactive preemption: slot in at the head of the
+                # batch-class suffix (queues stay partitioned, so a
+                # linear scan for the boundary is the whole cost)
+                i = 0
+                while i < len(q) and q[i].priority != "batch":
+                    i += 1
+                q.insert(i, req)
             self._pending += 1
+            self._pending_by[priority] += 1
             self._cv.notify_all()
         if self._thread is None and self._auto_start:
             self.start()
@@ -204,12 +249,16 @@ class MicroBatcher:
         return cap if cap is not None else DEFAULT_TARGET_ROWS
 
     def _oldest_key(self):
-        """The queue whose head request has waited longest (FIFO across
-        shapes: no shape can be starved by a busier one)."""
+        """The queue holding the longest-waiting request (FIFO across
+        shapes: no shape can be starved by a busier one).  The oldest
+        request need not be the head — interactive preemption reorders
+        within a queue — so the deadline scan covers every entry."""
         best_key, best_t = None, None
         for key, q in self._queues.items():
-            if q and (best_t is None or q[0].t_enqueue < best_t):
-                best_key, best_t = key, q[0].t_enqueue
+            if q:
+                t = min(r.t_enqueue for r in q)
+                if best_t is None or t < best_t:
+                    best_key, best_t = key, t
         return best_key
 
     def _evict_expired_locked(self, now: float) -> None:
@@ -222,6 +271,8 @@ class MicroBatcher:
             for r in expired:
                 q.remove(r)
                 self._pending -= 1
+                self._pending_by[r.priority] -= 1
+                self._reqs_by[r.priority] += 1
                 self._deadline_misses += 1
                 self._errors += 1
                 r.error = DeadlineExceeded(
@@ -252,7 +303,7 @@ class MicroBatcher:
                 q = self._queues[key]
                 target = self._target_rows()
                 queued_rows = sum(r.rows for r in q)
-                flush_at = q[0].t_enqueue + self.max_delay_s
+                flush_at = (min(r.t_enqueue for r in q) + self.max_delay_s)
                 # stopping: drain immediately rather than wait out SLOs
                 if (queued_rows < target and now < flush_at
                         and not self._stop):
@@ -265,10 +316,14 @@ class MicroBatcher:
                 batch = [q.popleft()]
                 rows = batch[0].rows
                 # head-of-line FIFO: take co-riders while they still fit
+                # (interactive preemption already put user-facing rows
+                # at the head, so they are the ones guaranteed to ride)
                 while q and rows + q[0].rows <= target:
                     batch.append(q.popleft())
                     rows += batch[-1].rows
                 self._pending -= len(batch)
+                for r in batch:
+                    self._pending_by[r.priority] -= 1
             self._execute(batch)
 
     # -- execution paths -----------------------------------------------------
@@ -325,7 +380,20 @@ class MicroBatcher:
             while self._recent and t_done - self._recent[0][0] > RATE_WINDOW_S:
                 self._recent.popleft()
             for r in batch:
-                self._latencies.append(t_done - r.t_enqueue)
+                lat = t_done - r.t_enqueue
+                self._latencies.append(lat)
+                self._lat_by[r.priority].append(lat)
+                self._reqs_by[r.priority] += 1
+                if err is None:
+                    h = self._lat_hist[r.priority]
+                    h["sum"] += lat
+                    h["count"] += 1
+                    for i, bound in enumerate(LATENCY_BUCKETS_S):
+                        if lat <= bound:
+                            h["counts"][i] += 1
+                            break
+                    else:
+                        h["inf"] += 1
             if degraded:
                 self._degraded_batches += 1
             if err is not None:
@@ -358,6 +426,25 @@ class MicroBatcher:
             deadline_misses = self._deadline_misses
             errors = self._errors
             degraded_batches = self._degraded_batches
+            priorities = {}
+            for p in PRIORITIES:
+                plat = sorted(self._lat_by[p])
+                h = self._lat_hist[p]
+                priorities[p] = {
+                    "queue_depth": self._pending_by[p],
+                    "requests": self._reqs_by[p],
+                    "latency_ms": {
+                        "p50": round(self._percentile(plat, 0.50) * 1e3, 3),
+                        "p99": round(self._percentile(plat, 0.99) * 1e3, 3),
+                    },
+                    "latency_hist_s": {
+                        "bounds": list(LATENCY_BUCKETS_S),
+                        "counts": list(h["counts"]),
+                        "inf": h["inf"],
+                        "sum": h["sum"],
+                        "count": h["count"],
+                    },
+                }
         cache = self.net.infer_cache.stats
         breaker = self.breaker.stats()
         return {
@@ -381,4 +468,5 @@ class MicroBatcher:
             "degraded_batches": degraded_batches,
             "degraded": breaker["state"] != CircuitBreaker.CLOSED,
             "breaker": breaker,
+            "priorities": priorities,
         }

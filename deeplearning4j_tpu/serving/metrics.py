@@ -1,0 +1,299 @@
+"""Prometheus text-format exporter for the serving fabric (stdlib-only).
+
+The TPU datacenter argument (Jouppi et al., 2017) is operational: the
+fleet runs latency-bounded inference, which means the fleet is operated
+off dashboards — queue depths, batch-size distributions, per-class
+latency histograms, breaker state.  This module turns the gateway's
+existing stats dicts (`MicroBatcher.stats()` → `ModelServer.stats()`,
+`Router.stats()`) into the Prometheus text exposition format 0.0.4 so a
+stock Prometheus scrape of `/metrics` on any replica or on the router
+needs no sidecar and no client library.
+
+Format contract (tested in tests/test_serving_fabric.py):
+  - every family gets exactly one `# HELP` and one `# TYPE` line;
+  - histogram families export cumulative `_bucket{le="..."}` series
+    ending in `le="+Inf"`, plus `_sum` and `_count`;
+  - counters only ever move up across scrapes (the underlying stats are
+    process-lifetime totals, never windowed);
+  - label values are escaped per the spec (backslash, quote, newline).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+#: what /metrics responses declare (the version IS part of the contract)
+CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+#: `le` bounds for the coalesced batch-size histogram (rows per device
+#: call); powers of two bracket every default bucket the infer cache
+#: grows, +Inf catches anything larger
+BATCH_ROWS_BOUNDS = (1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024)
+
+
+def escape_label_value(v) -> str:
+    return (str(v).replace("\\", "\\\\").replace("\n", "\\n")
+            .replace('"', '\\"'))
+
+
+def _fmt_value(v) -> str:
+    f = float(v)
+    if f == int(f) and abs(f) < 1e15:
+        return str(int(f))
+    return repr(f)
+
+
+def _fmt_labels(labels: Optional[Dict[str, str]]) -> str:
+    if not labels:
+        return ""
+    inner = ",".join(f'{k}="{escape_label_value(v)}"'
+                     for k, v in labels.items())
+    return "{" + inner + "}"
+
+
+class PrometheusText:
+    """Accumulates metric families and renders one exposition page.
+
+    Families keep insertion order; samples of one family stay together
+    under a single HELP/TYPE pair however many labeled series join it.
+    """
+
+    def __init__(self):
+        # name -> (type, help, [(suffix, labels, value)])
+        self._families: Dict[str, Tuple[str, str, List]] = {}
+        self._order: List[str] = []
+
+    def _family(self, name: str, mtype: str, help_text: str) -> List:
+        fam = self._families.get(name)
+        if fam is None:
+            fam = (mtype, help_text, [])
+            self._families[name] = fam
+            self._order.append(name)
+        return fam[2]
+
+    def gauge(self, name: str, help_text: str, value,
+              labels: Optional[Dict[str, str]] = None) -> None:
+        self._family(name, "gauge", help_text).append(("", labels, value))
+
+    def counter(self, name: str, help_text: str, value,
+                labels: Optional[Dict[str, str]] = None) -> None:
+        """`name` must already end in `_total` (spec convention)."""
+        self._family(name, "counter", help_text).append(("", labels, value))
+
+    def histogram(self, name: str, help_text: str, bounds, counts,
+                  inf: int, total_sum: float, total_count: int,
+                  labels: Optional[Dict[str, str]] = None) -> None:
+        """Append one histogram series.  `counts` are per-bucket
+        (NON-cumulative) observation counts aligned with `bounds`; the
+        cumulative sums the text format wants are computed here."""
+        fam = self._family(name, "histogram", help_text)
+        cum = 0
+        for bound, c in zip(bounds, counts):
+            cum += int(c)
+            lbl = dict(labels or {})
+            lbl["le"] = _fmt_value(bound)
+            fam.append(("_bucket", lbl, cum))
+        lbl = dict(labels or {})
+        lbl["le"] = "+Inf"
+        fam.append(("_bucket", lbl, cum + int(inf)))
+        fam.append(("_sum", dict(labels or {}), float(total_sum)))
+        fam.append(("_count", dict(labels or {}), int(total_count)))
+
+    def render(self) -> str:
+        lines: List[str] = []
+        for name in self._order:
+            mtype, help_text, samples = self._families[name]
+            lines.append(f"# HELP {name} {help_text}")
+            lines.append(f"# TYPE {name} {mtype}")
+            for suffix, labels, value in samples:
+                lines.append(
+                    f"{name}{suffix}{_fmt_labels(labels)} {_fmt_value(value)}")
+        return "\n".join(lines) + "\n"
+
+
+def _batch_rows_histogram(hist: Dict[str, int]):
+    """(counts per BATCH_ROWS_BOUNDS, inf, sum, count) from the exact
+    {rows: batches} histogram the batcher keeps."""
+    counts = [0] * len(BATCH_ROWS_BOUNDS)
+    inf = 0
+    total_sum = 0.0
+    total_count = 0
+    for rows_s, n in hist.items():
+        rows, n = int(rows_s), int(n)
+        total_sum += rows * n
+        total_count += n
+        for i, bound in enumerate(BATCH_ROWS_BOUNDS):
+            if rows <= bound:
+                counts[i] += n
+                break
+        else:
+            inf += n
+    return counts, inf, total_sum, total_count
+
+
+def replica_metrics(stats: dict, page: Optional[PrometheusText] = None,
+                    labels: Optional[Dict[str, str]] = None) -> str:
+    """Render a `ModelServer.stats()` dict as Prometheus text.
+
+    `labels` (e.g. {"replica": "0"}) are stamped on every series —
+    that's how the router re-exports each replica's metrics under one
+    scrape without name collisions.  Pass `page` to merge several stats
+    dicts into one exposition (again: the router)."""
+    own_page = page is None
+    p = PrometheusText() if own_page else page
+    base = dict(labels or {})
+
+    def lbl(**extra):
+        d = dict(base)
+        d.update(extra)
+        return d or None
+
+    p.gauge("dl4j_serving_ready", "1 once warmed and not draining.",
+            1 if stats.get("ready") else 0, lbl())
+    p.gauge("dl4j_serving_inflight",
+            "HTTP predict handlers currently in flight.",
+            stats.get("inflight", 0), lbl())
+    prios = stats.get("priorities", {})
+    for prio, ps in sorted(prios.items()):
+        p.gauge("dl4j_serving_queue_depth",
+                "Requests coalescing in the gateway queue.",
+                ps.get("queue_depth", 0), lbl(priority=prio))
+        p.counter("dl4j_serving_requests_total",
+                  "Requests completed (answered or failed).",
+                  ps.get("requests", 0), lbl(priority=prio))
+        h = ps.get("latency_hist_s")
+        if h:
+            p.histogram("dl4j_serving_request_latency_seconds",
+                        "Enqueue-to-answer latency of successful requests.",
+                        h["bounds"], h["counts"], h["inf"], h["sum"],
+                        h["count"], lbl(priority=prio))
+    counts, inf, bsum, bcount = _batch_rows_histogram(
+        stats.get("batch_rows_hist", {}))
+    p.histogram("dl4j_serving_batch_rows",
+                "Coalesced rows per device call.",
+                BATCH_ROWS_BOUNDS, counts, inf, bsum, bcount, lbl())
+    p.counter("dl4j_serving_rows_total", "Feature rows served.",
+              stats.get("rows", 0), lbl())
+    p.counter("dl4j_serving_errors_total",
+              "Requests answered with an error.",
+              stats.get("errors", 0), lbl())
+    p.counter("dl4j_serving_deadline_misses_total",
+              "Requests evicted past their deadline.",
+              stats.get("deadline_misses", 0), lbl())
+    p.counter("dl4j_serving_degraded_batches_total",
+              "Batches served by the eager (breaker-open) fallback.",
+              stats.get("degraded_batches", 0), lbl())
+    breaker = stats.get("breaker", {})
+    from deeplearning4j_tpu.reliability import CircuitBreaker
+    p.gauge("dl4j_serving_breaker_state",
+            "Execute-path circuit breaker: 0 closed, 1 open, 2 half-open.",
+            CircuitBreaker.STATE_CODES.get(breaker.get("state"), 0), lbl())
+    p.counter("dl4j_serving_breaker_opens_total",
+              "Times the breaker tripped open.",
+              breaker.get("opens", 0), lbl())
+    cache = stats.get("cache", {})
+    p.counter("dl4j_serving_cache_hits_total",
+              "Infer-cache in-memory program hits.",
+              cache.get("hits", 0), lbl())
+    p.counter("dl4j_serving_cache_misses_total",
+              "Infer-cache misses (fresh compiles; 0 on a warmed server).",
+              cache.get("misses", 0), lbl())
+    p.counter("dl4j_serving_cache_disk_hits_total",
+              "Programs restored from the persistent disk cache.",
+              cache.get("disk_hits", 0), lbl())
+    p.counter("dl4j_serving_cache_io_errors_total",
+              "Disk-cache I/O errors downgraded to misses.",
+              cache.get("io_errors", 0), lbl())
+    return p.render() if own_page else ""
+
+
+def router_metrics(stats: dict) -> str:
+    """Render a `Router.stats()` dict — the router's own counters plus a
+    re-export of every replica's last-known stats under a `replica`
+    label — as one Prometheus page."""
+    p = PrometheusText()
+    p.gauge("dl4j_router_ready", "1 while the router admits traffic.",
+            1 if stats.get("ready") else 0)
+    p.gauge("dl4j_router_inflight",
+            "Proxied requests currently in flight.", stats.get("inflight", 0))
+    p.gauge("dl4j_router_replicas_healthy",
+            "Replicas currently routable.", stats.get("healthy_replicas", 0))
+    for prio, ps in sorted(stats.get("priorities", {}).items()):
+        p.counter("dl4j_router_requests_total",
+                  "Requests routed (by priority class).",
+                  ps.get("requests", 0), {"priority": prio})
+        h = ps.get("latency_hist_s")
+        if h:
+            p.histogram("dl4j_router_request_latency_seconds",
+                        "Router-side latency of successfully proxied "
+                        "requests.", h["bounds"], h["counts"], h["inf"],
+                        h["sum"], h["count"], {"priority": prio})
+    p.counter("dl4j_router_retries_total",
+              "Requests retried on a sibling replica.",
+              stats.get("retries", 0))
+    p.counter("dl4j_router_unroutable_total",
+              "Requests answered 503: no routable replica.",
+              stats.get("unroutable", 0))
+    from deeplearning4j_tpu.reliability import CircuitBreaker
+    for rep in stats.get("replicas", []):
+        rl = {"replica": str(rep.get("index"))}
+        p.gauge("dl4j_router_replica_healthy",
+                "1 while the replica passes /readyz and its breaker "
+                "allows traffic.", 1 if rep.get("healthy") else 0, rl)
+        p.gauge("dl4j_router_replica_breaker_state",
+                "Per-replica routing breaker: 0 closed, 1 open, "
+                "2 half-open.",
+                CircuitBreaker.STATE_CODES.get(
+                    rep.get("breaker", {}).get("state"), 0), rl)
+        rep_stats = rep.get("stats")
+        if rep_stats:
+            replica_metrics(rep_stats, page=p, labels=rl)
+    return p.render()
+
+
+def parse_prometheus_text(text: str):
+    """Minimal conformance parser used by tests and doctors: returns
+    {metric sample name: {frozen labels: value}} and raises ValueError
+    on any line that is not valid exposition format."""
+    import re
+
+    sample_re = re.compile(
+        r"^([a-zA-Z_:][a-zA-Z0-9_:]*)"
+        r"(?:\{((?:[a-zA-Z_][a-zA-Z0-9_]*=\"(?:[^\"\\]|\\.)*\",?)*)\})?"
+        r" (-?(?:[0-9.eE+-]+|Inf|NaN))$")
+    label_re = re.compile(r"([a-zA-Z_][a-zA-Z0-9_]*)=\"((?:[^\"\\]|\\.)*)\"")
+    out: Dict[str, Dict] = {}
+    typed = set()
+    helped = set()
+    for lineno, line in enumerate(text.splitlines(), 1):
+        if not line:
+            continue
+        if line.startswith("# HELP "):
+            name = line.split(" ", 3)[2]
+            if name in helped:
+                raise ValueError(f"line {lineno}: duplicate HELP for {name}")
+            helped.add(name)
+            continue
+        if line.startswith("# TYPE "):
+            parts = line.split(" ")
+            if len(parts) != 4 or parts[3] not in (
+                    "counter", "gauge", "histogram", "summary", "untyped"):
+                raise ValueError(f"line {lineno}: bad TYPE line: {line!r}")
+            if parts[2] in typed:
+                raise ValueError(
+                    f"line {lineno}: duplicate TYPE for {parts[2]}")
+            typed.add(parts[2])
+            continue
+        if line.startswith("#"):
+            continue
+        m = sample_re.match(line)
+        if not m:
+            raise ValueError(f"line {lineno}: unparseable sample: {line!r}")
+        name, raw_labels, raw_value = m.groups()
+        labels = tuple(sorted(label_re.findall(raw_labels or "")))
+        value = float(raw_value.replace("Inf", "inf"))
+        series = out.setdefault(name, {})
+        if labels in series:
+            raise ValueError(f"line {lineno}: duplicate series {line!r}")
+        series[labels] = value
+    return out

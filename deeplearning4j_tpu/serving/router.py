@@ -1,0 +1,421 @@
+"""Multi-replica serving front end: one URL over N `ModelServer`s.
+
+The datacenter serving shape (Jouppi et al., 2017; Gemma-on-TPU in
+PAPERS.md) is a replicated, load-balanced fleet: each replica owns its
+chips and its coalescing queue, a thin front end spreads requests and
+routes around bad replicas.  `Router` is that front end, deliberately
+model-free — it never imports jax and holds no params, so one router
+process stays cheap while the replicas do the device work:
+
+  routing     POST /v1/predict is proxied to a healthy replica chosen
+              round-robin; connection errors and 5xx "replica is gone"
+              answers (502/503) fail over to the next replica within the
+              same request, so a replica death mid-flight costs a retry,
+              not an error.  Replica verdicts about the REQUEST
+              (400 bad input, 504 deadline) pass through untouched.
+  health      a background thread polls every replica's /readyz and
+              /v1/stats; an unready replica is ejected from rotation
+              until it passes again.  Each replica also carries a
+              `CircuitBreaker` fed by proxy outcomes — repeated
+              failures eject it even between polls, half-open probes
+              let it back.
+  priorities  the router parses each request's `priority` class for its
+              own per-class accounting, then forwards the raw body —
+              the replica's coalescing queue applies the actual
+              preemption (serving/batcher.py).
+  drain       `drain()` mirrors the replica SIGTERM contract: stop
+              admitting (new predicts and readyz go 503), wait out
+              in-flight proxies, close.  The CLI drains the router
+              FIRST, then SIGTERMs the replicas, so every accepted
+              request finds its replica still alive.
+  metrics     GET /metrics exports the router's own counters plus every
+              replica's last-polled stats re-labeled {replica="i"}
+              (serving/metrics.py) — one scrape sees the whole fleet.
+
+Replica processes share one warmed disk compile cache
+(`optimize/persist.py` is multi-process-safe), so N replicas pay the
+trace/compile cost zero times after one `warmup` — see the CLI's
+`serve --replicas N`.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, List, Optional
+from urllib.error import HTTPError, URLError
+from urllib.parse import urlparse
+from urllib.request import Request, urlopen
+
+from deeplearning4j_tpu.reliability import CircuitBreaker
+from deeplearning4j_tpu.serving.batcher import LATENCY_BUCKETS_S, PRIORITIES
+
+#: replica answers that mean "this replica can't serve anyone right now"
+#: (drain/overload) — retry the SAME request on a sibling
+_RETRYABLE_CODES = (502, 503)
+
+
+class Replica:
+    """One backend `ModelServer` as the router sees it: URL, routing
+    breaker, last-polled health and stats."""
+
+    def __init__(self, index: int, url: str,
+                 breaker: Optional[CircuitBreaker] = None):
+        self.index = int(index)
+        self.url = url.rstrip("/")
+        # trips after a few consecutive proxy failures; short reset so a
+        # restarted replica rejoins within a couple of poll intervals
+        self.breaker = breaker if breaker is not None else CircuitBreaker(
+            failure_threshold=3, reset_timeout_s=2.0)
+        self._lock = threading.Lock()
+        self._ready = False
+        self._stats: Optional[dict] = None
+
+    @property
+    def ready(self) -> bool:
+        with self._lock:
+            return self._ready
+
+    @property
+    def last_stats(self) -> Optional[dict]:
+        with self._lock:
+            return self._stats
+
+    def routable(self) -> bool:
+        """In rotation: passed the last /readyz poll AND the routing
+        breaker admits traffic (closed, or a half-open probe)."""
+        return self.ready and self.breaker.allow()
+
+    def poll(self, timeout_s: float = 2.0) -> bool:
+        """Refresh readiness (and, when ready, cached stats) from the
+        replica; never raises."""
+        try:
+            with urlopen(self.url + "/readyz", timeout=timeout_s) as r:
+                ready = r.status == 200
+        except (URLError, HTTPError, OSError, ValueError):
+            ready = False
+        stats = None
+        if ready:
+            try:
+                with urlopen(self.url + "/v1/stats", timeout=timeout_s) as r:
+                    stats = json.loads(r.read().decode())
+            except (URLError, HTTPError, OSError, ValueError):
+                pass
+        with self._lock:
+            self._ready = ready
+            if stats is not None:
+                self._stats = stats
+        return ready
+
+    def describe(self) -> dict:
+        with self._lock:
+            return {
+                "index": self.index,
+                "url": self.url,
+                "healthy": self._ready,
+                "breaker": self.breaker.stats(),
+                "stats": self._stats,
+            }
+
+
+class _RouterHandler(BaseHTTPRequestHandler):
+    router: "Router" = None
+
+    def _send_json(self, body, code: int = 200) -> None:
+        data = json.dumps(body).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def do_GET(self):  # noqa: N802
+        path = urlparse(self.path).path
+        rt = self.router
+        if path == "/v1/stats":
+            self._send_json(rt.stats())
+        elif path == "/metrics":
+            from deeplearning4j_tpu.serving.metrics import (CONTENT_TYPE,
+                                                            router_metrics)
+            data = router_metrics(rt.stats()).encode()
+            self.send_response(200)
+            self.send_header("Content-Type", CONTENT_TYPE)
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+        elif path == "/healthz":
+            self._send_json({"ok": True})
+        elif path == "/readyz":
+            if rt.is_ready():
+                self._send_json({"ready": True,
+                                 "replicas": rt.healthy_count()})
+            else:
+                self._send_json({"ready": False, "draining": rt.draining},
+                                503)
+        else:
+            self._send_json({"error": "not found"}, 404)
+
+    def do_POST(self):  # noqa: N802
+        if urlparse(self.path).path != "/v1/predict":
+            self._send_json({"error": "not found"}, 404)
+            return
+        rt = self.router
+        if not rt.enter_request():
+            self._send_json({"error": "draining: router is shutting down"},
+                            503)
+            return
+        try:
+            n = int(self.headers.get("Content-Length", 0))
+            raw = self.rfile.read(n)
+            code, body = rt.route_predict(raw)
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+        finally:
+            rt.exit_request()
+
+    def log_message(self, *args):  # quiet
+        pass
+
+
+class Router:
+    """HTTP front end routing `/v1/predict` across replica URLs.
+
+    replicas:        backend base URLs (e.g. from `ReplicaProcess.url`).
+    poll_interval_s: /readyz + /v1/stats refresh cadence.
+    request_timeout_s: per-proxy-attempt timeout toward a replica.
+    """
+
+    def __init__(self, replicas: List[str], host: str = "127.0.0.1",
+                 port: int = 0, poll_interval_s: float = 0.5,
+                 request_timeout_s: float = 35.0):
+        if not replicas:
+            raise ValueError("Router needs at least one replica URL")
+        self.replicas = [Replica(i, u) for i, u in enumerate(replicas)]
+        self.poll_interval_s = float(poll_interval_s)
+        self.request_timeout_s = float(request_timeout_s)
+        handler = type("Handler", (_RouterHandler,), {"router": self})
+        self.server = ThreadingHTTPServer((host, port), handler)
+        self.port = self.server.server_address[1]
+        self._thread: Optional[threading.Thread] = None
+        self._poll_thread: Optional[threading.Thread] = None
+        self._poll_stop = threading.Event()
+        self._state_lock = threading.Lock()
+        self._ready = False
+        self._draining = False
+        self._drained = False
+        self._inflight = 0
+        self._rr = 0  # round-robin cursor
+        self._stop_requested = threading.Event()
+        # -- stats (guarded by _state_lock) --------------------------------
+        self._retries = 0
+        self._unroutable = 0
+        self._reqs_by: Dict[str, int] = {p: 0 for p in PRIORITIES}
+        self._lat_hist = {p: {"counts": [0] * len(LATENCY_BUCKETS_S),
+                              "inf": 0, "sum": 0.0, "count": 0}
+                          for p in PRIORITIES}
+
+    # -- admission ----------------------------------------------------------
+    @property
+    def draining(self) -> bool:
+        with self._state_lock:
+            return self._draining
+
+    def is_ready(self) -> bool:
+        with self._state_lock:
+            if not self._ready or self._draining:
+                return False
+        return self.healthy_count() > 0
+
+    def healthy_count(self) -> int:
+        return sum(1 for r in self.replicas if r.ready)
+
+    def enter_request(self) -> bool:
+        with self._state_lock:
+            if self._draining:
+                return False
+            self._inflight += 1
+            return True
+
+    def exit_request(self) -> None:
+        with self._state_lock:
+            self._inflight -= 1
+
+    # -- routing ------------------------------------------------------------
+    def _rotation(self) -> List[Replica]:
+        """Routable replicas starting at the round-robin cursor; when
+        none pass `routable()` fall back to every ready replica (a
+        breaker-open replica beats answering 503 outright)."""
+        with self._state_lock:
+            start = self._rr
+            self._rr += 1
+        order = [self.replicas[(start + i) % len(self.replicas)]
+                 for i in range(len(self.replicas))]
+        routable = [r for r in order if r.routable()]
+        return routable or [r for r in order if r.ready]
+
+    @staticmethod
+    def _request_priority(raw: bytes) -> str:
+        """The request's priority class, for the router's own per-class
+        accounting; malformed bodies count as the default class and are
+        forwarded untouched — the replica owns rejection."""
+        try:
+            prio = json.loads(raw.decode() or "{}").get("priority",
+                                                        "interactive")
+        except (ValueError, UnicodeDecodeError):
+            return "interactive"
+        return prio if prio in PRIORITIES else "interactive"
+
+    def _observe(self, priority: str, latency_s: float, ok: bool) -> None:
+        with self._state_lock:
+            self._reqs_by[priority] += 1
+            if ok:
+                h = self._lat_hist[priority]
+                h["sum"] += latency_s
+                h["count"] += 1
+                for i, bound in enumerate(LATENCY_BUCKETS_S):
+                    if latency_s <= bound:
+                        h["counts"][i] += 1
+                        break
+                else:
+                    h["inf"] += 1
+
+    def route_predict(self, raw: bytes):
+        """Proxy one predict body; returns (status code, response bytes).
+
+        Fail-over policy: connection-level failures and 502/503 from a
+        replica trip its breaker and move on to the next; any other
+        answer (200, 400, 504...) is the replica's verdict on the
+        REQUEST and passes through with a breaker success."""
+        priority = self._request_priority(raw)
+        t0 = time.monotonic()
+        tried = 0
+        for rep in self._rotation():
+            tried += 1
+            if tried > 1:
+                with self._state_lock:
+                    self._retries += 1
+            req = Request(rep.url + "/v1/predict", data=raw,
+                          headers={"Content-Type": "application/json"},
+                          method="POST")
+            try:
+                with urlopen(req, timeout=self.request_timeout_s) as r:
+                    code, body = r.status, r.read()
+            except HTTPError as e:
+                code, body = e.code, e.read()
+            except (URLError, OSError) as e:
+                rep.breaker.record_failure()
+                last = (502, json.dumps(
+                    {"error": f"replica {rep.index} unreachable: "
+                              f"{e}"}).encode())
+                continue
+            if code in _RETRYABLE_CODES:
+                rep.breaker.record_failure()
+                last = (code, body)
+                continue
+            rep.breaker.record_success()
+            self._observe(priority, time.monotonic() - t0, code == 200)
+            return code, body
+        self._observe(priority, time.monotonic() - t0, False)
+        with self._state_lock:
+            self._unroutable += 1
+        if tried:
+            return last
+        return 503, json.dumps({"error": "no healthy replica"}).encode()
+
+    # -- health polling ------------------------------------------------------
+    def _poll_loop(self) -> None:
+        # wait first: start() already polled synchronously, and polling
+        # again right away would race a caller who changes the fleet
+        # between start() and the first interval
+        while not self._poll_stop.wait(self.poll_interval_s):
+            for rep in self.replicas:
+                rep.poll()
+
+    def poll_once(self) -> int:
+        """Synchronous health refresh of every replica (startup, tests);
+        returns how many are ready."""
+        for rep in self.replicas:
+            rep.poll()
+        return self.healthy_count()
+
+    # -- observability -------------------------------------------------------
+    def stats(self) -> dict:
+        with self._state_lock:
+            priorities = {
+                p: {"requests": self._reqs_by[p],
+                    "latency_hist_s": {
+                        "bounds": list(LATENCY_BUCKETS_S),
+                        "counts": list(self._lat_hist[p]["counts"]),
+                        "inf": self._lat_hist[p]["inf"],
+                        "sum": self._lat_hist[p]["sum"],
+                        "count": self._lat_hist[p]["count"]}}
+                for p in PRIORITIES}
+            out = {
+                "ready": self._ready and not self._draining,
+                "draining": self._draining,
+                "inflight": self._inflight,
+                "retries": self._retries,
+                "unroutable": self._unroutable,
+                "priorities": priorities,
+            }
+        out["replicas"] = [r.describe() for r in self.replicas]
+        out["healthy_replicas"] = self.healthy_count()
+        return out
+
+    # -- lifecycle ------------------------------------------------------------
+    def start(self) -> "Router":
+        self.poll_once()
+        self._poll_thread = threading.Thread(
+            target=self._poll_loop, name="dl4j-router-health", daemon=True)
+        self._poll_thread.start()
+        self._thread = threading.Thread(target=self.server.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+        with self._state_lock:
+            self._ready = True
+        return self
+
+    def request_stop(self) -> None:
+        """Signal-handler-safe: set the event; the thread parked in
+        `wait_for_stop()` performs the drain."""
+        self._stop_requested.set()
+
+    def wait_for_stop(self, timeout: Optional[float] = None) -> bool:
+        return self._stop_requested.wait(timeout)
+
+    def drain(self, timeout_s: float = 10.0) -> None:
+        """Stop admitting (predicts/readyz → 503), wait out in-flight
+        proxies, close.  Replica processes outlive this call — the
+        caller SIGTERMs them afterwards so every accepted request still
+        finds its replica; idempotent."""
+        with self._state_lock:
+            if self._drained:
+                return
+            self._drained = True
+            self._draining = True
+        self._stop_requested.set()
+        self._poll_stop.set()
+        deadline = time.monotonic() + float(timeout_s)
+        if self._thread is not None:
+            self.server.shutdown()
+        while time.monotonic() < deadline:
+            with self._state_lock:
+                if self._inflight == 0:
+                    break
+            time.sleep(0.005)
+        if self._poll_thread is not None:
+            self._poll_thread.join(timeout=max(deadline - time.monotonic(),
+                                               0.1))
+        self.server.server_close()
+
+    def stop(self) -> None:
+        self.drain()
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.server.server_address[0]}:{self.port}"

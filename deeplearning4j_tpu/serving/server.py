@@ -1,15 +1,20 @@
 """HTTP front of the micro-batching gateway (sibling of `ui/server.py`).
 
-  POST /v1/predict   {"features": [[...], ...], "deadline_ms": 250?}
+  POST /v1/predict   {"features": [[...], ...], "deadline_ms": 250?,
+                      "priority": "interactive"|"batch"?}
                      -> {"output": [...], "rows": n}
                      (503 + {"error": ...} when the gateway queue is full
                      or the server is draining, 504 when a request waits
-                     out `request_timeout_s` or its own `deadline_ms`)
+                     out `request_timeout_s` or its own `deadline_ms`;
+                     "interactive" — the default — preempts queued
+                     "batch" work in the coalescing queue)
   GET  /v1/stats     gateway counters (queue depth, batch-size histogram,
                      p50/p95/p99 latency, rows/s, fresh-compile count,
                      deadline misses, breaker state, `degraded` flag) plus
                      the infer cache's stats block (`disk_hits` etc.), so a
                      warmed server is observable in one curl.
+  GET  /metrics      the same counters in Prometheus text exposition
+                     format (serving/metrics.py) for a stock scrape.
   GET  /healthz      liveness: 200 while the process can answer at all.
   GET  /readyz       readiness: 200 only once `start()` ran (post-warmup)
                      and the server is not draining — what a load
@@ -41,7 +46,8 @@ from urllib.parse import urlparse
 import numpy as np
 
 from deeplearning4j_tpu.reliability import CircuitBreaker, DeadlineExceeded
-from deeplearning4j_tpu.serving.batcher import MicroBatcher, ServerOverloaded
+from deeplearning4j_tpu.serving.batcher import (PRIORITIES, MicroBatcher,
+                                                ServerOverloaded)
 
 
 class ServerDraining(RuntimeError):
@@ -67,6 +73,15 @@ class _ServeHandler(BaseHTTPRequestHandler):
         path = urlparse(self.path).path
         if path == "/v1/stats":
             self._send(self.model_server.stats())
+        elif path == "/metrics":
+            from deeplearning4j_tpu.serving.metrics import (CONTENT_TYPE,
+                                                            replica_metrics)
+            data = replica_metrics(self.model_server.stats()).encode()
+            self.send_response(200)
+            self.send_header("Content-Type", CONTENT_TYPE)
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
         elif path == "/healthz":
             self._send({"ok": True})
         elif path == "/readyz":
@@ -94,6 +109,11 @@ class _ServeHandler(BaseHTTPRequestHandler):
                 deadline_ms = body.get("deadline_ms")
                 if deadline_ms is not None:
                     deadline_ms = float(deadline_ms)
+                priority = body.get("priority", "interactive")
+                if priority not in PRIORITIES:
+                    raise ValueError(
+                        f"priority must be one of {PRIORITIES}; "
+                        f"got {priority!r}")
             except (KeyError, ValueError, TypeError,
                     json.JSONDecodeError) as e:
                 self._send({"error": f"bad request: {e}"}, 400)
@@ -101,7 +121,8 @@ class _ServeHandler(BaseHTTPRequestHandler):
             if feats.ndim == 1:  # single example: make it a 1-row batch
                 feats = feats[None, :]
             try:
-                out = ms.predict(feats, deadline_ms=deadline_ms)
+                out = ms.predict(feats, deadline_ms=deadline_ms,
+                                 priority=priority)
             except ServerOverloaded as e:
                 self._send({"error": f"overloaded: {e}"}, 503)
                 return
@@ -186,7 +207,8 @@ class ModelServer:
             self._inflight -= 1
 
     def predict(self, feats: np.ndarray,
-                deadline_ms: Optional[float] = None) -> np.ndarray:
+                deadline_ms: Optional[float] = None,
+                priority: str = "interactive") -> np.ndarray:
         if self.draining:
             raise ServerDraining("server is draining")
         if deadline_ms is None:
@@ -194,7 +216,8 @@ class ModelServer:
         if self.batching:
             return self.batcher.predict(feats,
                                         timeout=self.request_timeout_s,
-                                        deadline_ms=deadline_ms)
+                                        deadline_ms=deadline_ms,
+                                        priority=priority)
         return np.asarray(self.net.output(feats))
 
     def stats(self) -> dict:

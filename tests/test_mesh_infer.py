@@ -1,0 +1,215 @@
+"""Mesh-sharded inference (ISSUE 7 tentpole a): the serve-path compile
+cache shards coalesced rows across a `Mesh(('batch',))` with replicated
+params, sharding joins the cache key so single-chip and mesh programs
+coexist (memory AND disk), buckets round to mesh multiples, and mesh
+outputs stay bitwise-identical to the single-chip path.
+
+Tier-1: CPU-only (1-device fallback mesh); the 2-device subprocess
+bitwise check is marked slow."""
+
+import os
+import subprocess
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.models.zoo import mlp
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.optimize.infer_cache import InferCache
+from deeplearning4j_tpu.optimize.persist import PersistentProgramStore
+from deeplearning4j_tpu.parallel.mesh import (SERVE_AXIS, infer_shardings,
+                                              serve_mesh)
+
+N_IN, N_OUT = 6, 3
+
+
+def _net(seed=0):
+    return MultiLayerNetwork(mlp(n_in=N_IN, hidden=[8], n_out=N_OUT,
+                                 lr=0.05), seed=seed).init()
+
+
+def _x(rows, seed=0):
+    rng = np.random.RandomState(seed)
+    return jnp.asarray(rng.randn(rows, N_IN).astype(np.float32))
+
+
+# -- mesh helpers ------------------------------------------------------------
+
+def test_serve_mesh_shape_and_shardings():
+    mesh = serve_mesh()
+    assert mesh.axis_names == (SERVE_AXIS,)
+    assert mesh.devices.size == len(jax.devices())
+    rep, bat = infer_shardings(mesh)
+    assert rep.spec == jax.sharding.PartitionSpec()
+    assert bat.spec == jax.sharding.PartitionSpec(SERVE_AXIS)
+
+
+# -- bitwise parity ----------------------------------------------------------
+
+def test_mesh_output_bitwise_identical_to_direct():
+    """The acceptance bar: mesh-sharded rows == direct net.output()
+    bit-for-bit (1-device CPU mesh; sharding is a cache-key dimension,
+    not a numeric change)."""
+    net = _net()
+    x = _x(5, seed=1)
+    direct = np.asarray(net.output(x))
+    net.set_serve_mesh()
+    mesh_out = np.asarray(net.output(x))
+    np.testing.assert_array_equal(direct, mesh_out)
+
+
+def test_mesh_feed_forward_and_loss_bitwise():
+    net = _net()
+    x = _x(4, seed=2)
+    y = jnp.asarray(np.eye(N_OUT, dtype=np.float32)[
+        np.random.RandomState(3).randint(0, N_OUT, 4)])
+    direct_ff = [np.asarray(a) for a in net.feed_forward(x)]
+    direct_loss = float(net.score(x, y))
+    net.set_serve_mesh()
+    mesh_ff = [np.asarray(a) for a in net.feed_forward(x)]
+    mesh_loss = float(net.score(x, y))
+    assert len(direct_ff) == len(mesh_ff)
+    for a, b in zip(direct_ff, mesh_ff):
+        np.testing.assert_array_equal(a, b)
+    assert direct_loss == mesh_loss  # f32-bit-equal
+
+
+# -- sharding as a cache-key dimension ---------------------------------------
+
+def test_single_and_mesh_programs_coexist_without_eviction():
+    """Same (entry, fingerprint, bucket) under both shardings: two cache
+    entries, and flipping back re-HITS the original program instead of
+    recompiling (no eviction thrash)."""
+    net = _net()
+    cache = net.infer_cache
+    x = _x(4)
+    net.output(x)
+    assert cache.stats.misses == 1
+    net.set_serve_mesh()
+    net.output(x)
+    assert cache.stats.misses == 2  # mesh program is its own entry
+    tags = {k[-1] for k in cache._programs}
+    assert InferCache.SINGLE in tags
+    assert any(isinstance(t, tuple) and t[0] == "mesh" for t in tags)
+    # flip back and forth: pure hits from here on
+    cache.set_mesh(None)
+    net.output(x)
+    net.set_serve_mesh()
+    net.output(x)
+    assert cache.stats.misses == 2
+    assert cache.stats.hits >= 2
+    assert len(cache._programs) == 2
+
+
+def test_sharding_tag_distinguishes_mesh_shapes():
+    c = InferCache()
+    assert c.sharding_tag() == InferCache.SINGLE
+    c.set_mesh(serve_mesh())
+    tag = c.sharding_tag()
+    assert tag[0] == "mesh" and tag[1] == (SERVE_AXIS,)
+    c.set_mesh(None)
+    assert c.sharding_tag() == InferCache.SINGLE
+
+
+# -- bucket rounding under a mesh --------------------------------------------
+
+def test_serve_bucket_rounds_to_mesh_multiple(monkeypatch):
+    """With m devices every bucket must split evenly: known divisible
+    buckets are reused, otherwise the bucket grows to the next multiple
+    of m (simulated 4-way mesh on 1 CPU device)."""
+    c = InferCache()
+    c.set_mesh(serve_mesh())
+    monkeypatch.setattr(c, "_mesh_rows", lambda: 4)
+    assert c._serve_bucket(5) == 8   # ceil(5/4)*4, registered
+    assert 8 in c.buckets
+    assert c._serve_bucket(3) == 8   # smallest known divisible bucket
+    assert c._serve_bucket(8) == 8
+    assert c._serve_bucket(9) == 12
+    # single-chip calls still use plain bucket growth
+    monkeypatch.setattr(c, "_mesh_rows", lambda: 1)
+    assert c._serve_bucket(5) == 8
+
+
+def test_fixed_buckets_respected_under_mesh(monkeypatch):
+    c = InferCache(buckets=(4, 16))
+    c.set_mesh(serve_mesh())
+    monkeypatch.setattr(c, "_mesh_rows", lambda: 4)
+    assert c._serve_bucket(5) == 16   # next fixed divisible bucket
+    assert c._serve_bucket(17) == 20  # target beyond fixed list, not stored
+    assert list(c.buckets) == [4, 16]
+
+
+# -- disk persistence of mesh-keyed programs ---------------------------------
+
+def test_mesh_programs_persist_and_disk_hit(tmp_path):
+    """Mesh programs round-trip the disk store under their own key: a
+    restarted process with the same mesh disk-hits, and the single-chip
+    entry for the same bucket lives alongside it."""
+    net = _net()
+    store = PersistentProgramStore(str(tmp_path))
+    net.infer_cache.set_persist(store)
+    x = _x(4, seed=5)
+    single = np.asarray(net.output(x))       # single-chip entry
+    net.set_serve_mesh()
+    meshed = np.asarray(net.output(x))       # mesh entry
+    np.testing.assert_array_equal(single, meshed)
+    assert store.writes == 2
+
+    net2 = _net()
+    net2.infer_cache.set_persist(PersistentProgramStore(str(tmp_path)))
+    net2.set_serve_mesh()
+    out2 = np.asarray(net2.output(x))
+    assert net2.infer_cache.stats.misses == 0
+    assert net2.infer_cache.stats.disk_hits == 1
+    np.testing.assert_array_equal(meshed, out2)
+
+
+# -- padding under mesh ------------------------------------------------------
+
+def test_ragged_rows_pad_to_mesh_bucket_bitwise():
+    """A ragged batch pads into a mesh-divisible bucket and the sliced
+    rows still match the direct path bit-for-bit."""
+    net = _net()
+    for rows in (1, 3, 5, 7):
+        x = _x(rows, seed=10 + rows)
+        direct = np.asarray(net.output(x))
+        net.set_serve_mesh()
+        mesh_out = np.asarray(net.output(x))
+        net.infer_cache.set_mesh(None)
+        np.testing.assert_array_equal(direct, mesh_out,
+                                      err_msg=f"rows={rows}")
+
+
+# -- the real thing: 2 forced host devices, sharded execution ----------------
+
+@pytest.mark.slow
+def test_two_device_mesh_bitwise_subprocess():
+    """On 2 forced host CPU devices the mesh program actually splits
+    rows across devices — outputs must still be bitwise == direct."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    code = """
+import numpy as np
+from deeplearning4j_tpu.models.zoo import mlp
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+import jax
+assert len(jax.devices()) == 2, jax.devices()
+net = MultiLayerNetwork(mlp(n_in=6, hidden=[8], n_out=3, lr=0.05),
+                        seed=0).init()
+x = np.random.RandomState(0).randn(6, 6).astype("float32")
+direct = np.asarray(net.output(x))
+mesh = net.set_serve_mesh()
+assert int(mesh.devices.size) == 2
+out = np.asarray(net.output(x))
+assert np.array_equal(direct, out)
+print("OK")
+"""
+    env = {**os.environ, "JAX_PLATFORMS": "cpu",
+           "XLA_FLAGS": (os.environ.get("XLA_FLAGS", "") +
+                         " --xla_force_host_platform_device_count=2")}
+    r = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                       text=True, cwd=repo, env=env, timeout=300)
+    assert r.returncode == 0, (r.stdout, r.stderr)
+    assert "OK" in r.stdout

@@ -321,3 +321,99 @@ def test_cli_train_and_warmup_emit_disk_cache_stats(tmp_path, capsys):
     info = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
     assert info["infer_cache_misses"] == 0
     assert info["disk_cache"]["disk_hits"] >= 1
+
+
+# -- multi-process sibling writers (ISSUE 7 satellite) ------------------------
+
+def test_sibling_eviction_is_a_plain_miss_not_a_crash(tmp_path):
+    """Replica B evicts an entry replica A knows about: A's next load is
+    a counted miss, never an exception, and A recompiles cleanly."""
+    a = PersistentProgramStore(str(tmp_path))
+    b = PersistentProgramStore(str(tmp_path))
+    key = ("infer-cache", "fp", "output", "sig")
+    assert a.store(key, _exported(2.0))
+    assert b.load(key) is not None      # both see the shared entry
+    b.evict(key)                        # sibling eviction
+    assert a.load(key) is None          # plain miss
+    assert a.io_errors == 0 and a.corrupt_evicted == 0
+    assert a.store(key, _exported(2.0))  # rewrite works
+    assert a.load(key) is not None
+
+
+def test_enforce_cap_tolerates_vanished_entries(tmp_path):
+    """LRU eviction over a stale snapshot (a sibling removed files
+    between listdir and remove): vanished files count as `vanished`,
+    not `evictions`, and the sweep completes."""
+    store = PersistentProgramStore(str(tmp_path), max_bytes=1)
+    keys = [("k", i) for i in range(3)]
+    exported = _exported(1.5)
+    for k in keys:
+        store.store(k, exported)
+    real = store._entries()
+    assert len(real) >= 1
+    ghost = os.path.join(store.directory, "0" * 40 + ".jxp")
+    stale = [(ghost, 123, 0.0)] + real  # oldest entry no longer exists
+    store.evictions = store.vanished = 0
+    orig_entries = store._entries
+    store._entries = lambda: stale
+    try:
+        store._enforce_cap()
+    finally:
+        store._entries = orig_entries
+    assert store.vanished == 1
+    assert store.evictions >= 1  # the real entries still got swept
+
+
+def test_corrupt_entry_vanishing_under_eviction_counts_vanished(tmp_path):
+    """A corrupt entry that a sibling removes between our read and our
+    evict counts `vanished`, not `corrupt_evicted`."""
+    store = PersistentProgramStore(str(tmp_path))
+    key = ("k", "corrupt-race")
+    store.store(key, _exported(3.0))
+    path = store.path_for(key)
+    with open(path, "r+b") as f:
+        f.seek(30)
+        f.write(b"\xff\xff\xff\xff")
+    os_remove = os.remove
+
+    def racing_remove(p):
+        # the sibling wins the race just before our eviction
+        os_remove(p)
+        raise FileNotFoundError(p)
+
+    import deeplearning4j_tpu.optimize.persist as persist_mod
+    orig = persist_mod.os.remove
+    persist_mod.os.remove = racing_remove
+    try:
+        assert store.load(key) is None
+    finally:
+        persist_mod.os.remove = orig
+    assert store.vanished == 1
+    assert store.corrupt_evicted == 0
+
+
+def test_sibling_writers_same_key_converge(tmp_path):
+    """Two stores hammering the same key concurrently: no torn reads, no
+    exceptions, both converge on a loadable entry."""
+    a = PersistentProgramStore(str(tmp_path))
+    b = PersistentProgramStore(str(tmp_path))
+    key = ("k", "shared")
+    exported = _exported(4.0)
+    errors = []
+
+    def worker(store):
+        try:
+            for _ in range(10):
+                store.store(key, exported)
+                store.load(key)
+        except BaseException as e:  # noqa: BLE001 — the assertion
+            errors.append(e)
+
+    threads = [threading.Thread(target=worker, args=(s,))
+               for s in (a, b, a, b)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors
+    assert a.load(key) is not None and b.load(key) is not None

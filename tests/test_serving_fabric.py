@@ -1,0 +1,376 @@
+"""Multi-replica serving fabric (ISSUE 7 tentpole b): priority classes
+in the coalescing queue, Prometheus /metrics conformance on replica and
+router, the router's routing/ejection/drain behavior over real
+`ModelServer`s, and the 2-replica CLI smoke — subprocess replicas on a
+shared warmed compile cache, SIGTERM drain with a fault-harness delay
+holding a request in flight, exit 0.
+
+Tier-1: CPU-only; the subprocess smoke uses short drain timeouts."""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.models.zoo import mlp
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.parallel import checkpoint
+from deeplearning4j_tpu.serving import (PRIORITIES, MicroBatcher, Router,
+                                        parse_prometheus_text,
+                                        replica_metrics, router_metrics)
+
+N_IN, N_OUT = 6, 3
+
+
+def _net(seed=0):
+    return MultiLayerNetwork(mlp(n_in=N_IN, hidden=[8], n_out=N_OUT,
+                                 lr=0.05), seed=seed).init()
+
+
+def _x(rows, seed=0):
+    rng = np.random.RandomState(seed)
+    return rng.randn(rows, N_IN).astype(np.float32)
+
+
+def _http(url, body=None, timeout=30):
+    req = urllib.request.Request(
+        url, data=None if body is None else json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, resp.read().decode()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode()
+
+
+# -- priority classes in the coalescing queue --------------------------------
+
+def test_priority_validation():
+    b = MicroBatcher(_net(), auto_start=False)
+    with pytest.raises(ValueError):
+        b.predict(_x(1), priority="urgent")
+
+
+def test_interactive_preempts_queued_batch():
+    """With the dispatcher parked, enqueue batch-class requests then an
+    interactive one: the queue must hold [interactive, batch, batch] so
+    the next flush serves the user-facing rows first."""
+    b = MicroBatcher(_net(), auto_start=False)  # dispatcher never starts
+    done = []
+
+    def enqueue(prio, i):
+        try:
+            b.predict(_x(1, seed=i), timeout=30.0, priority=prio)
+            done.append((prio, i))
+        except Exception:  # noqa: BLE001 — drain answers them later
+            pass
+
+    threads = []
+    for i, prio in enumerate(["batch", "batch", "interactive", "batch"]):
+        t = threading.Thread(target=enqueue, args=(prio, i))
+        t.start()
+        threads.append(t)
+        deadline = time.monotonic() + 10.0
+        while b.queue_depth() < i + 1 and time.monotonic() < deadline:
+            time.sleep(0.005)
+    assert b.queue_depth() == 4
+    with b._cv:
+        (q,) = b._queues.values()
+        order = [r.priority for r in q]
+    assert order == ["interactive", "batch", "batch", "batch"]
+    st = b.stats()
+    assert st["priorities"]["interactive"]["queue_depth"] == 1
+    assert st["priorities"]["batch"]["queue_depth"] == 3
+    b.start()
+    b.stop()  # drain-on-stop answers everyone
+    for t in threads:
+        t.join(timeout=30.0)
+        assert not t.is_alive()
+    assert len(done) == 4
+
+
+def test_per_priority_latency_histograms_accumulate():
+    net = _net()
+    net.warmup([4])
+    b = MicroBatcher(net, max_delay_ms=1.0).start()
+    try:
+        b.predict(_x(2), timeout=30.0, priority="interactive")
+        b.predict(_x(2), timeout=30.0, priority="batch")
+    finally:
+        b.stop()
+    st = b.stats()
+    for prio in PRIORITIES:
+        h = st["priorities"][prio]["latency_hist_s"]
+        assert sum(h["counts"]) + h["inf"] == h["count"] == 1
+        assert h["sum"] > 0.0
+        assert st["priorities"][prio]["requests"] == 1
+
+
+# -- Prometheus text-format conformance --------------------------------------
+
+def _assert_monotonic(before: dict, after: dict):
+    """Every counter/histogram-cumulative series only ever moves up."""
+    for name, series in before.items():
+        if not (name.endswith("_total") or name.endswith("_bucket")
+                or name.endswith("_count") or name.endswith("_sum")):
+            continue
+        for labels, value in series.items():
+            assert after[name][labels] >= value, (name, labels)
+
+
+def test_replica_metrics_conformance_and_monotonic_counters():
+    net = _net()
+    net.warmup([4])
+    server = net.serve(max_delay_ms=1.0)
+    try:
+        _http(server.url + "/v1/predict",
+              {"features": _x(2, seed=1).tolist(), "priority": "batch"})
+        code, text1 = _http(server.url + "/metrics")
+        assert code == 200
+        parsed1 = parse_prometheus_text(text1)  # raises on any bad line
+        for family in ("dl4j_serving_queue_depth",
+                       "dl4j_serving_batch_rows_bucket",
+                       "dl4j_serving_request_latency_seconds_bucket",
+                       "dl4j_serving_breaker_state",
+                       "dl4j_serving_cache_hits_total",
+                       "dl4j_serving_cache_disk_hits_total"):
+            assert family in parsed1, family
+        # priority label present on the latency histogram
+        lat = parsed1["dl4j_serving_request_latency_seconds_bucket"]
+        prios = {dict(lbl).get("priority") for lbl in lat}
+        assert prios == set(PRIORITIES)
+        _http(server.url + "/v1/predict",
+              {"features": _x(3, seed=2).tolist()})
+        code, text2 = _http(server.url + "/metrics")
+        parsed2 = parse_prometheus_text(text2)
+        _assert_monotonic(parsed1, parsed2)
+        # and the second scrape actually observed the new request
+        key = (("priority", "interactive"),)
+        assert (parsed2["dl4j_serving_requests_total"][key]
+                > parsed1["dl4j_serving_requests_total"][key])
+    finally:
+        server.stop()
+
+
+def test_metrics_content_type_and_histogram_shape():
+    net = _net()
+    net.warmup([4])
+    text = replica_metrics(net.serve(max_delay_ms=1.0).stats())
+    parsed = parse_prometheus_text(text)
+    buckets = parsed["dl4j_serving_batch_rows_bucket"]
+    infs = [v for lbl, v in buckets.items() if dict(lbl)["le"] == "+Inf"]
+    assert len(infs) == 1
+    assert infs[0] == parsed["dl4j_serving_batch_rows_count"][()]
+
+
+def test_parser_rejects_malformed_lines():
+    with pytest.raises(ValueError):
+        parse_prometheus_text("this is not a metric line\n")
+    with pytest.raises(ValueError):
+        parse_prometheus_text("# TYPE foo widget\n")
+    with pytest.raises(ValueError):
+        parse_prometheus_text("ok_metric 1\nok_metric 2\n")  # dup series
+
+
+# -- router over in-process ModelServers -------------------------------------
+
+def _start_pair(poll_interval_s=0.1):
+    nets = [_net(seed=0), _net(seed=0)]
+    for n in nets:
+        n.warmup([4])
+    servers = [n.serve(max_delay_ms=1.0) for n in nets]
+    router = Router([s.url for s in servers],
+                    poll_interval_s=poll_interval_s).start()
+    return servers, router
+
+
+def test_router_routes_and_reports():
+    servers, router = _start_pair()
+    try:
+        for i in range(4):
+            code, body = _http(router.url + "/v1/predict",
+                               {"features": _x(2, seed=i).tolist(),
+                                "priority": PRIORITIES[i % 2]})
+            assert code == 200, body
+            assert len(json.loads(body)["output"]) == 2
+        st = router.stats()
+        assert st["healthy_replicas"] == 2
+        assert sum(p["requests"] for p in st["priorities"].values()) == 4
+        # round-robin actually spread the work
+        per_replica = [r["stats"]["requests"] if r["stats"] else 0
+                       for r in st["replicas"]]
+        router.poll_once()
+        per_replica = [r["stats"]["requests"] if r["stats"] else 0
+                       for r in router.stats()["replicas"]]
+        assert all(n >= 1 for n in per_replica), per_replica
+        code, text = _http(router.url + "/metrics")
+        assert code == 200
+        parsed = parse_prometheus_text(text)
+        assert "dl4j_router_requests_total" in parsed
+        # replica-labeled re-export of the serving families
+        reps = {dict(lbl).get("replica")
+                for lbl in parsed["dl4j_serving_rows_total"]}
+        assert reps == {"0", "1"}
+    finally:
+        router.stop()
+        for s in servers:
+            s.stop()
+
+
+def test_router_ejects_dead_replica_and_recovers_traffic():
+    # background poller parked (huge interval): health transitions are
+    # driven deterministically by poll_once(), with no stale in-flight
+    # poll racing the assertions below
+    servers, router = _start_pair(poll_interval_s=3600.0)
+    try:
+        servers[0].stop()          # replica 0 gone: connections refused
+        router.poll_once()
+        assert router.healthy_count() == 1
+        # every request still lands (on replica 1), possibly via retry
+        for i in range(4):
+            code, body = _http(router.url + "/v1/predict",
+                               {"features": _x(1, seed=i).tolist()})
+            assert code == 200, body
+        assert router.is_ready()   # 1 healthy replica keeps readyz 200
+    finally:
+        router.stop()
+        for s in servers:
+            s.stop()
+
+
+def test_router_drain_stops_admission():
+    servers, router = _start_pair()
+    try:
+        router.drain(timeout_s=5.0)
+        # replicas outlive the router drain (the CLI terminates them
+        # afterwards, so their own drains can finish queued work)
+        assert all(s.is_ready() for s in servers)
+        with pytest.raises((urllib.error.URLError, ConnectionError)):
+            _http(router.url + "/readyz")  # front door is closed
+    finally:
+        for s in servers:
+            s.stop()
+
+
+def test_router_503_when_no_replica():
+    net = _net()
+    net.warmup([4])
+    server = net.serve(max_delay_ms=1.0)
+    # poller parked — poll_once() drives health (see ejection test)
+    router = Router([server.url], poll_interval_s=3600.0).start()
+    try:
+        server.stop()
+        assert router.poll_once() == 0
+        code, body = _http(router.url + "/v1/predict",
+                           {"features": _x(1).tolist()})
+        assert code == 503, body
+        assert router.stats()["unroutable"] >= 1
+        code, _ = _http(router.url + "/readyz")
+        assert code == 503
+    finally:
+        router.stop()
+
+
+def test_router_metrics_parse_without_traffic():
+    servers, router = _start_pair()
+    try:
+        parsed = parse_prometheus_text(router_metrics(router.stats()))
+        assert parsed["dl4j_router_replicas_healthy"][()] == 2
+    finally:
+        router.stop()
+        for s in servers:
+            s.stop()
+
+
+# -- the real thing: 2-replica CLI router, warmed cache, SIGTERM drain -------
+
+def test_cli_router_two_replicas_warmed_drain_exit_zero(tmp_path):
+    """The ISSUE 7 acceptance smoke: shared warmed disk cache -> both
+    replicas start with fresh_compiles == 0; a fault-harness delay keeps
+    a request in flight when SIGTERM lands; the router+replicas drain
+    answering every accepted request and exit 0."""
+    net = _net()
+    ckpt = str(tmp_path / "model")
+    cache = str(tmp_path / "cache")
+    checkpoint.save(ckpt, net.params, conf=net.conf)
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = {**os.environ, "JAX_PLATFORMS": "cpu",
+           # PR 5 fault harness: every dispatcher execute sleeps 200ms,
+           # so the straggler below is genuinely in flight at SIGTERM
+           "DL4J_FAULT_PLAN": "dispatcher.execute=delay:0.2"}
+    subprocess.run(
+        [sys.executable, "-m", "deeplearning4j_tpu.cli", "warmup",
+         "--model", ckpt, "--compile-cache", cache, "--shapes", "4"],
+        check=True, capture_output=True, cwd=repo, env=env, timeout=300)
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "deeplearning4j_tpu.cli", "serve",
+         "--model", ckpt, "--compile-cache", cache, "--shapes", "4",
+         "--replicas", "2", "--port", "0", "--max-delay-ms", "50",
+         "--drain-timeout", "10"],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        cwd=repo, env=env)
+    try:
+        watchdog = threading.Timer(240.0, proc.kill)
+        watchdog.start()
+        try:
+            summary = json.loads(proc.stdout.readline())
+        finally:
+            watchdog.cancel()
+        url = summary["url"]
+        assert len(summary["replicas"]) == 2
+        # the acceptance bar: warmed shared cache, zero fresh compiles
+        assert summary["fresh_compiles"] == [0, 0]
+
+        code, body = _http(url + "/v1/predict",
+                           {"features": _x(2, seed=1).tolist()}, timeout=60)
+        assert code == 200 and json.loads(body)["rows"] == 2
+
+        # metrics scrape parses and counters are monotonic across scrapes
+        code, text1 = _http(url + "/metrics", timeout=60)
+        assert code == 200
+        parsed1 = parse_prometheus_text(text1)
+        _http(url + "/v1/predict",
+              {"features": _x(1, seed=3).tolist(), "priority": "batch"},
+              timeout=60)
+        code, text2 = _http(url + "/metrics", timeout=60)
+        parsed2 = parse_prometheus_text(text2)
+        _assert_monotonic(parsed1, parsed2)
+
+        # leave a request IN FLIGHT (50ms coalesce + 200ms fault delay)
+        # when the SIGTERM lands: the fleet drain must still answer it
+        inflight = {}
+
+        def straggler():
+            try:
+                inflight["resp"] = _http(
+                    url + "/v1/predict",
+                    {"features": _x(1, seed=2).tolist()}, timeout=60)
+            except Exception as e:  # noqa: BLE001
+                inflight["error"] = e
+
+        t = threading.Thread(target=straggler)
+        t.start()
+        time.sleep(0.1)
+        proc.send_signal(signal.SIGTERM)
+        t.join(timeout=90.0)
+        assert not t.is_alive()
+        assert "resp" in inflight, inflight.get("error")
+        assert inflight["resp"][0] == 200
+
+        out, err = proc.communicate(timeout=180)
+        assert proc.returncode == 0, (out, err)
+        drained = json.loads(out.strip().splitlines()[-1])
+        assert drained["drained"] is True
+        assert drained["replica_exit_codes"] == [0, 0]
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.communicate()
