@@ -753,6 +753,107 @@ def bench_serve(devs) -> None:
 
 
 # ---------------------------------------------------------------------------
+# serve precision — the same closed loop under each f32/bf16/int8 policy
+# ---------------------------------------------------------------------------
+
+def bench_serve_precision(devs) -> None:
+    """Closed-loop clients through the micro-batching gateway under each
+    serve-precision policy (optimize/quantize.py) on the charTransformer:
+    f32 is the baseline arm, then bf16 and int8 rerun the SAME client
+    fleet on the same bucket.  The policy is part of the infer-cache
+    key, so each arm's programs are warmed before its timed window and
+    `fresh_compiles_during_serving` must stay 0 — the low-precision path
+    never pays a compile at traffic time.  Every arm emits its own line
+    with rows/s, p50/p99, and the accuracy delta `set_serve_precision`
+    measured against f32 on a held-out batch; vs_baseline on the
+    bf16/int8 lines is the rows/s multiple over the f32 arm.  On CPU
+    XLA emulates bf16 in float32, so the multiple only means something
+    on an accelerator — `cpu_fallback` tags the lines there."""
+    from deeplearning4j_tpu.models.zoo import char_transformer
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.serving import MicroBatcher
+
+    if SMALL:
+        clients, secs, vocab, seq = 4, 0.6, 32, 16
+        conf = char_transformer(vocab, d_model=16, n_blocks=1, n_heads=2,
+                                max_seq_len=seq)
+    else:
+        clients, secs, vocab, seq = 16, 4.0, 96, 64
+        conf = char_transformer(vocab, d_model=128, n_blocks=2, n_heads=4,
+                                max_seq_len=seq)
+    net = MultiLayerNetwork(conf, seed=0).init()
+    rng = np.random.RandomState(0)
+    xs = [rng.randint(0, vocab, size=(1, seq)).astype(np.int32)
+          for _ in range(clients)]
+
+    def closed_loop(batcher):
+        lat = []
+        rows = [0] * clients
+        lock = threading.Lock()
+        start_evt = threading.Event()
+        stop_t = [0.0]
+
+        def client(i):
+            start_evt.wait()
+            while time.perf_counter() < stop_t[0]:
+                t0 = time.perf_counter()
+                try:
+                    batcher.predict(xs[i], timeout=60.0, deadline_ms=2000.0)
+                except Exception:
+                    continue
+                dt = time.perf_counter() - t0
+                with lock:
+                    lat.append(dt)
+                rows[i] += 1
+
+        threads = [threading.Thread(target=client, args=(i,))
+                   for i in range(clients)]
+        for t in threads:
+            t.start()
+        t_begin = time.perf_counter()
+        stop_t[0] = t_begin + secs
+        start_evt.set()
+        for t in threads:
+            t.join()
+        dt = time.perf_counter() - t_begin
+
+        def pct(q):
+            vals = sorted(lat)
+            if not vals:
+                return 0.0
+            return vals[min(len(vals) - 1, int(q * (len(vals) - 1)))] * 1e3
+
+        return sum(rows) / dt, pct(0.50), pct(0.99)
+
+    f32_rows_s = None
+    for policy in ("f32", "bf16", "int8"):
+        report = net.set_serve_precision(policy)
+        # warm the coalesced bucket AND the single-row bucket under THIS
+        # policy (the policy is a cache-key dimension) so the timed
+        # window is pure hits
+        net.warmup([np.zeros((clients, seq), np.int32),
+                    np.zeros((1, seq), np.int32)])
+        misses_before = net.infer_cache.stats.misses
+        batcher = MicroBatcher(net, max_delay_ms=2.0).start()
+        rows_s, p50_ms, p99_ms = closed_loop(batcher)
+        st = batcher.stats()
+        batcher.stop()
+        if policy == "f32":
+            f32_rows_s = rows_s
+        delta = (report or {}).get("accuracy_delta") or {}
+        _emit(f"serve precision {policy} rows/sec", rows_s, "rows/sec",
+              None if policy == "f32" else rows_s / max(f32_rows_s, 1e-9),
+              clients=clients, seq_len=seq,
+              p50_ms=round(p50_ms, 2), p99_ms=round(p99_ms, 2),
+              top1_delta_vs_f32=delta.get("top1_delta"),
+              rel_mse_vs_f32=delta.get("rel_mse"),
+              fresh_compiles_during_serving=(
+                  st["fresh_compiles"] - misses_before),
+              baseline_note="vs_baseline = rows/s multiple vs the f32 arm, "
+                            "same closed-loop clients and bucket")
+
+
+# ---------------------------------------------------------------------------
 # serve router — closed-loop HTTP clients across {1, 2} replica processes
 # ---------------------------------------------------------------------------
 
@@ -1073,7 +1174,8 @@ def bench_cold_start(devs) -> None:
 BENCHES = [bench_lenet, bench_char_lstm, bench_vgg_cifar10, bench_word2vec,
            bench_dp_allreduce,
            bench_char_lstm4, bench_step_cache, bench_infer_latency,
-           bench_serve, bench_serve_router, bench_prefetch,
+           bench_serve, bench_serve_precision, bench_serve_router,
+           bench_prefetch,
            bench_cold_start, bench_north_star_cli, bench_transformer_mfu]
 BASELINE_FIVE = {"bench_lenet", "bench_char_lstm", "bench_vgg_cifar10",
                  "bench_word2vec", "bench_dp_allreduce"}

@@ -399,11 +399,18 @@ def cmd_warmup(args) -> int:
     else:
         raise SystemExit("warmup needs --model <conf.json | checkpoint dir>")
     net.set_compile_cache(args.compile_cache)
+    precision = getattr(args, "precision", "f32")
+    if precision != "f32":
+        # BEFORE warmup, so the warmed programs carry the policy cache
+        # key (and the int8 quantized-weights artifact lands in the
+        # compile cache for the serving processes to reload)
+        net.set_serve_precision(precision, measure=False)
     shapes = _parse_shapes(args.shapes)
     if not shapes:
         raise SystemExit("warmup needs --shapes (e.g. 256,1024 or 32x784)")
     entries = tuple(e.strip() for e in args.entries.split(",") if e.strip())
     summary = net.warmup(shapes, entries=entries, train=args.train)
+    summary["precision"] = net.serve_precision
     summary["disk_cache"] = _disk_stats(net)
     print(json.dumps(summary))
     return 0
@@ -431,6 +438,14 @@ def _build_server(args):
     if getattr(args, "mesh", False):
         # before warmup, so the warmed programs carry the mesh cache key
         mesh_devices = int(net.set_serve_mesh().devices.size)
+    precision = getattr(args, "precision", "f32")
+    precision_report = None
+    if precision != "f32":
+        # same ordering rule as the mesh: set the policy BEFORE warmup,
+        # so the warmed programs carry the policy cache key (a warmup
+        # run with the same --precision prefilled the disk store, so
+        # these are disk restores, not compiles)
+        precision_report = net.set_serve_precision(precision)
     shapes = _parse_shapes(args.shapes)
     warmed = None
     if shapes:
@@ -453,6 +468,8 @@ def _build_server(args):
                "fresh_compiles": net.infer_cache.stats.misses,
                "batching": not args.no_batching,
                "mesh_devices": mesh_devices,
+               "precision": net.serve_precision,
+               "precision_report": precision_report,
                "disk_cache": _disk_stats(net)}
     return net, server, summary
 
@@ -510,6 +527,8 @@ def _replica_cmd(args) -> List[str]:
         cmd += ["--default-deadline-ms", str(args.default_deadline_ms)]
     if getattr(args, "mesh", False):
         cmd += ["--mesh"]
+    if getattr(args, "precision", "f32") != "f32":
+        cmd += ["--precision", args.precision]
     return cmd
 
 
@@ -693,6 +712,13 @@ def build_parser() -> argparse.ArgumentParser:
                         "output,feed_forward,loss")
     w.add_argument("--train", action="store_true",
                    help="also compile the train step for each shape")
+    w.add_argument("--precision", choices=["f32", "bf16", "int8"],
+                   default="f32",
+                   help="serve-precision policy to warm under (set BEFORE "
+                        "compiling, so the warmed programs — and for int8 "
+                        "the quantized-weights artifact — carry the policy "
+                        "cache key a `serve --precision` process will look "
+                        "up)")
     w.set_defaults(fn=cmd_warmup)
 
     s = sub.add_parser("serve",
@@ -749,6 +775,14 @@ def build_parser() -> argparse.ArgumentParser:
                         "visible device (Mesh(('batch',)), params "
                         "replicated); bitwise-identical outputs, one "
                         "program per sharding in the compile cache")
+    s.add_argument("--precision", choices=["f32", "bf16", "int8"],
+                   default="f32",
+                   help="serve-precision policy (optimize/quantize.py): "
+                        "bf16 casts weights on load, int8 quantizes them "
+                        "per-channel with calibrated scales; applied "
+                        "BEFORE warmup so warmed programs carry the "
+                        "policy cache key; f32 (default) stays bitwise-"
+                        "identical to not passing the flag")
     s.set_defaults(fn=cmd_serve)
     return ap
 

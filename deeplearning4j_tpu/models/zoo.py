@@ -262,3 +262,51 @@ def char_transformer(vocab: int, d_model: int = 128, n_blocks: int = 2,
     return MultiLayerConfiguration(
         confs=tuple(confs), backprop=True,
         input_preprocessors=((2 * n_blocks + 1, "rnn_to_ff"),))
+
+
+# -- serve-precision eval slice ----------------------------------------------
+
+#: Declared per-model error budgets for the low-precision serving
+#: policies (optimize/quantize.py): softmax heads budget the top-1
+#: disagreement vs the f32 reference, the reconstruction head budgets
+#: relative output MSE.  `quantize.error_budget_report` measures every
+#: model/policy pair against these in tier-1 (deterministic on CPU) —
+#: a quantization regression fails the build before it ships.
+PRECISION_ERROR_BUDGETS = {
+    "lenet5": {
+        "bf16": {"top1_delta": 0.05, "rel_mse": 5e-4},
+        "int8": {"top1_delta": 0.10, "rel_mse": 5e-3},
+    },
+    "char_lstm": {
+        "bf16": {"top1_delta": 0.05, "rel_mse": 5e-4},
+        "int8": {"top1_delta": 0.10, "rel_mse": 5e-3},
+    },
+    "char_transformer": {
+        "bf16": {"top1_delta": 0.08, "rel_mse": 1e-3},
+        "int8": {"top1_delta": 0.15, "rel_mse": 1e-2},
+    },
+    "deep_autoencoder": {
+        "bf16": {"rel_mse": 5e-4},
+        "int8": {"rel_mse": 5e-3},
+    },
+}
+
+
+def precision_eval_confs(small: bool = True):
+    """The four-model zoo slice the precision eval harness runs —
+    LeNet (conv), char-LSTM (recurrent), charTransformer (attention),
+    deep-AE (reconstruction) — sized for CPU tier-1 when `small`."""
+    if small:
+        return {
+            "lenet5": lenet5(),
+            "char_lstm": char_lstm(24, hidden=24, n_layers=1),
+            "char_transformer": char_transformer(
+                24, d_model=16, n_blocks=1, n_heads=2, max_seq_len=16),
+            "deep_autoencoder": deep_autoencoder(n_in=32, hidden=(16, 8)),
+        }
+    return {
+        "lenet5": lenet5(),
+        "char_lstm": char_lstm(64, hidden=256, n_layers=1),
+        "char_transformer": char_transformer(64),
+        "deep_autoencoder": deep_autoencoder(),
+    }

@@ -348,6 +348,11 @@ class MultiLayerNetwork:
         cache_dir = os.environ.get("DL4J_COMPILE_CACHE")
         if cache_dir:
             self.set_compile_cache(cache_dir)
+        # serve-precision policy report (set_serve_precision): policy
+        # name + calibration facts + measured accuracy delta — serving
+        # has no labels, so the delta is measured here, once, and the
+        # batcher/server/router surface it read-only
+        self._serve_precision_report: dict = {"policy": "f32"}
 
     # -- lifecycle ---------------------------------------------------------
     def _next_key(self):
@@ -391,6 +396,79 @@ class MultiLayerNetwork:
             mesh = serve_mesh()
         self.infer_cache.set_mesh(mesh)
         return mesh
+
+    @property
+    def serve_precision(self) -> str:
+        """Active serve-path precision policy ("f32" until changed)."""
+        return self.infer_cache.policy
+
+    @property
+    def serve_precision_report(self) -> dict:
+        """The report `set_serve_precision` produced for the active
+        policy (calibration facts + measured accuracy delta)."""
+        return self._serve_precision_report
+
+    def set_serve_precision(self, policy: str = "f32", calibration=None,
+                            measure: bool = True) -> dict:
+        """Serve every subsequent `output`/`feed_forward`/`score` call —
+        and every program `warmup()` compiles from here on — under a
+        precision policy (optimize/quantize.py): "f32" (default,
+        bitwise-unchanged), "bf16" (params cast on load, bf16 compute),
+        or "int8" (per-channel symmetric weight quantization, scales
+        calibrated on `calibration` — a held-out batch; None builds a
+        deterministic synthetic one shaped for the conf).
+
+        The policy is a cache-KEY dimension like the serve mesh, so
+        per-policy programs coexist in memory and in the disk store.
+        With a persistent store attached, the int8 quantized weights are
+        themselves persisted (checksummed, LRU'd) keyed by (conf
+        fingerprint, params digest) — a restarted process reloads the
+        exact same scales instead of recalibrating.  int8 quantizes a
+        SNAPSHOT of the current params; after further training, call
+        this again to requantize.
+
+        Returns (and retains, see `serve_precision_report`) a report
+        with the measured accuracy delta vs f32 on a held-out batch
+        (`measure=False` skips the measurement forwards)."""
+        from deeplearning4j_tpu.optimize import quantize
+
+        quantize.validate_policy(policy)
+        if self.params is None:
+            self.init()
+        qparams = cal_report = None
+        if policy == "int8":
+            if calibration is None:
+                calibration = quantize.default_calibration(self.conf)
+            calibration = jnp.asarray(calibration)
+            store = self.infer_cache.persist
+            art_key = quantize.quantize_artifact_key(
+                self.infer_cache._fingerprint(self.conf),
+                quantize.params_digest(self.params))
+            blob = store.load_bytes(art_key) if store is not None else None
+            if blob is not None:
+                try:
+                    qparams, cal_report = quantize.unpack_quantized(blob)
+                except Exception:  # noqa: BLE001 — recalibrate instead
+                    qparams = None
+            if qparams is None:
+                qparams, cal_report = quantize.calibrate_int8(
+                    self.conf, self.params, calibration)
+                if store is not None:
+                    store.store_bytes(
+                        art_key, quantize.pack_quantized(qparams, cal_report))
+        self.infer_cache.set_policy(policy, qparams=qparams)
+        report = {"policy": policy}
+        if cal_report:
+            report["calibration"] = cal_report
+        if measure and policy != "f32":
+            # held out from the calibration batch when that defaulted
+            batch = (calibration if calibration is not None
+                     else quantize.default_calibration(self.conf, seed=1))
+            report["accuracy_delta"] = quantize.accuracy_delta(
+                self.conf, self.params, jnp.asarray(batch), policy,
+                qparams=qparams)
+        self._serve_precision_report = report
+        return report
 
     def warmup(self, shapes, entries=("output",), train=False):
         """Precompile the serve/train programs for the given batch shapes

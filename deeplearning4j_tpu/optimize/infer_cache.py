@@ -32,6 +32,15 @@ the dominant non-compute overhead of accelerator inference.
                  the mesh size so every shard gets equal rows.  Row
                  independence makes mesh outputs bitwise-identical to
                  the single-chip program's.
+  precision     `set_policy("bf16"|"int8")` (optimize/quantize.py) adds
+                 a `("policy", name)` element to the key — f32 keys are
+                 UNCHANGED (and so stay valid against pre-policy disk
+                 stores and stay bitwise-identical in behavior), while
+                 bf16/int8 programs coexist per policy in memory and on
+                 disk, composing with the sharding tag.  bf16 params
+                 are cast once on the host (memoized per tree); int8
+                 serves the fixed quantized snapshot installed with the
+                 policy and dequantizes to bf16 in-graph.
   no donation   unlike the train cache, inference programs NEVER donate
                  their params buffer: the same params serve every call.
   observability `cache.stats` (hits / misses / steps / compile seconds)
@@ -87,6 +96,13 @@ class InferCache(CompiledProgramCache):
         # memoized replicated placement of the last-served params tree
         # (holds the original tree so identity can't be recycled)
         self._placed_params: Tuple = (None, None)
+        # serve-precision policy (optimize/quantize.py): a cache-key
+        # dimension, so per-policy programs coexist like mesh ones do
+        self._policy = "f32"
+        self._qparams = None          # int8: fixed quantized snapshot
+        # memoized bf16 cast of the last-served params tree (same
+        # identity discipline as _placed_params)
+        self._policy_params: Tuple = (None, None)
 
     def _donate_argnums(self) -> Tuple[int, ...]:
         # serve-path params are reused by every subsequent call (and by
@@ -113,6 +129,78 @@ class InferCache(CompiledProgramCache):
     @property
     def mesh(self):
         return self._mesh
+
+    # -- precision policy ---------------------------------------------------
+    def set_policy(self, policy: str, qparams=None) -> None:
+        """Serve every subsequent call under `policy` ("f32" | "bf16" |
+        "int8").  int8 needs the prepared quantized tree (quantization +
+        calibration are the caller's job — `MultiLayerNetwork.
+        set_serve_precision` owns that, including disk persistence).
+        Like `set_mesh`, already-compiled programs stay cached under
+        their own policy tag: flipping between policies re-hits, never
+        evicts or recompiles."""
+        from deeplearning4j_tpu.optimize.quantize import validate_policy
+
+        validate_policy(policy)
+        if policy == "int8" and qparams is None:
+            raise ValueError("int8 policy needs the quantized params tree "
+                             "(use MultiLayerNetwork.set_serve_precision)")
+        with self._lock:
+            self._policy = policy
+            self._qparams = qparams if policy == "int8" else None
+            self._policy_params = (None, None)
+            self._placed_params = (None, None)
+
+    @property
+    def policy(self) -> str:
+        return self._policy
+
+    def _policy_suffix(self) -> Tuple:
+        """Cache-key elements the policy contributes.  f32 contributes
+        NOTHING — its keys (and therefore its disk-store paths and its
+        outputs) are byte-identical to the pre-policy serve path."""
+        if self._policy == "f32":
+            return ()
+        return (("policy", self._policy),)
+
+    def _serve_params(self, params):
+        """The params tree the policy's programs take as argument: f32
+        passes through; bf16 is a memoized cast-on-load of the incoming
+        tree (tracks training — a new tree re-casts); int8 is the fixed
+        snapshot `set_policy` installed (requantization is deliberate,
+        never implicit)."""
+        policy = self._policy
+        if policy == "f32":
+            return params
+        if policy == "int8":
+            return self._qparams
+        with self._lock:
+            held, cast = self._policy_params
+        if held is not params:
+            from deeplearning4j_tpu.optimize.quantize import cast_params_bf16
+
+            cast = cast_params_bf16(params)
+            with self._lock:
+                self._policy_params = (params, cast)
+        return cast
+
+    def programs_summary(self):
+        """Resident compiled programs as (entry, bucket, sharding,
+        policy) rows — the `/v1/stats` `programs` block operators use to
+        verify warmup coverage across every cache-key dimension."""
+        with self._lock:
+            keys = list(self._programs)
+        rows = []
+        for k in keys:
+            entry, _, sig, tag = k[0], k[1], k[2], k[3]
+            policy = k[4][1] if len(k) > 4 else "f32"
+            bucket = int(sig[0][0][0]) if sig and sig[0] and sig[0][0] else 0
+            sharding = (tag if isinstance(tag, str)
+                        else "mesh:" + "x".join(str(d) for d in tag[2]))
+            rows.append({"entry": entry, "bucket": bucket,
+                         "sharding": sharding, "policy": policy})
+        return sorted(rows, key=lambda r: (r["entry"], r["bucket"],
+                                           r["sharding"], r["policy"]))
 
     def _mesh_rows(self) -> int:
         """Row-divisibility the current sharding demands (1 = no mesh)."""
@@ -151,7 +239,9 @@ class InferCache(CompiledProgramCache):
         single-chip."""
         if self._mesh is None:
             return None
-        return (self._replicated,) + (self._batch_sharding,) * n_batch_args
+        from deeplearning4j_tpu.parallel.mesh import serve_placements
+
+        return serve_placements(self._mesh, n_batch_args)
 
     def _place(self, params, *batch_args) -> Tuple:
         """Device placement for execution under the mesh: params
@@ -181,15 +271,16 @@ class InferCache(CompiledProgramCache):
         n = int(x.shape[0])
         bucket = self._serve_bucket(n)
         xp = pad_rows(x, bucket)
+        policy, sp = self._policy, self._serve_params(params)
         key = ("output", self._fingerprint(conf), arg_signature(xp),
-               self.sharding_tag())
-        fn = self._get(key, lambda: _output_program(conf), (params, xp),
+               self.sharding_tag()) + self._policy_suffix()
+        fn = self._get(key, lambda: _output_program(conf, policy), (sp, xp),
                        shardings=self._shardings(1))
         if compile_only:
             return None
         with self._lock:
             self.stats.steps += 1
-        return truncate_rows(fn(*self._place(params, xp)), bucket, n)
+        return truncate_rows(fn(*self._place(sp, xp)), bucket, n)
 
     def feed_forward(self, conf, params, x, compile_only: bool = False):
         """`feed_forward` through the cache: the per-layer activation
@@ -197,16 +288,17 @@ class InferCache(CompiledProgramCache):
         n = int(x.shape[0])
         bucket = self._serve_bucket(n)
         xp = pad_rows(x, bucket)
+        policy, sp = self._policy, self._serve_params(params)
         key = ("feed_forward", self._fingerprint(conf), arg_signature(xp),
-               self.sharding_tag())
-        fn = self._get(key, lambda: _feed_forward_program(conf), (params, xp),
-                       shardings=self._shardings(1))
+               self.sharding_tag()) + self._policy_suffix()
+        fn = self._get(key, lambda: _feed_forward_program(conf, policy),
+                       (sp, xp), shardings=self._shardings(1))
         if compile_only:
             return None
         with self._lock:
             self.stats.steps += 1
         return [truncate_rows(a, bucket, n)
-                for a in fn(*self._place(params, xp))]
+                for a in fn(*self._place(sp, xp))]
 
     def loss(self, conf, params, x, y, compile_only: bool = False):
         """`network_loss(training=False)` through the cache: the
@@ -216,47 +308,86 @@ class InferCache(CompiledProgramCache):
         n = int(x.shape[0])
         bucket = self._serve_bucket(n)
         xp, yp, w = self.pad_batch(x, y, bucket)
+        policy, sp = self._policy, self._serve_params(params)
         key = ("loss", self._fingerprint(conf), arg_signature(xp, yp, w),
-               self.sharding_tag())
-        fn = self._get(key, lambda: _loss_program(conf), (params, xp, yp, w),
-                       shardings=self._shardings(3))
+               self.sharding_tag()) + self._policy_suffix()
+        fn = self._get(key, lambda: _loss_program(conf, policy),
+                       (sp, xp, yp, w), shardings=self._shardings(3))
         if compile_only:
             return None
         with self._lock:
             self.stats.steps += 1
-        return fn(*self._place(params, xp, yp, w))
+        return fn(*self._place(sp, xp, yp, w))
 
 
-def _output_program(conf) -> Callable:
+def _policy_conf(conf, policy: str):
+    """The conf a policy's programs trace against (f32: the original —
+    byte-for-byte the pre-policy program)."""
+    if policy == "f32":
+        return conf
+    from deeplearning4j_tpu.optimize.quantize import serve_conf
+
+    return serve_conf(conf, policy)
+
+
+def _policy_args(params, policy: str):
+    """In-graph view of the program's params argument: int8 sub-dicts
+    dequantize to bf16 right here, inside the traced program."""
+    if policy == "f32":
+        return params
+    from deeplearning4j_tpu.optimize.quantize import runtime_params
+
+    return runtime_params(params, policy)
+
+
+def _output_program(conf, policy: str = "f32") -> Callable:
     # local import: nn.multilayer imports this module at top level
     from deeplearning4j_tpu.nn.multilayer import network_output
 
+    pconf = _policy_conf(conf, policy)
+
     def program(params, x):
-        return network_output(conf, params, x, key=None, training=False)
+        out = network_output(pconf, _policy_args(params, policy), x,
+                             key=None, training=False)
+        # low-precision programs hand back f32 so every caller — the
+        # batcher, eval, bitwise tests — sees one output contract
+        return out if policy == "f32" else out.astype(jnp.float32)
 
     return program
 
 
-def _feed_forward_program(conf) -> Callable:
+def _feed_forward_program(conf, policy: str = "f32") -> Callable:
     from deeplearning4j_tpu.nn.multilayer import feed_forward
 
+    pconf = _policy_conf(conf, policy)
+
     def program(params, x):
-        return tuple(feed_forward(conf, params, x, key=None, training=False))
+        acts = feed_forward(pconf, _policy_args(params, policy), x,
+                            key=None, training=False)
+        if policy != "f32":
+            acts = [a.astype(jnp.float32) for a in acts]
+        return tuple(acts)
 
     return program
 
 
-def _loss_program(conf) -> Callable:
+def _loss_program(conf, policy: str = "f32") -> Callable:
     from deeplearning4j_tpu.nn.multilayer import (network_regularization,
                                                   network_rowwise_loss)
 
+    pconf = _policy_conf(conf, policy)
+
     def program(params, x, y, w):
-        rows = network_rowwise_loss(conf, params, x, y, key=None,
+        p = _policy_args(params, policy)
+        rows = network_rowwise_loss(pconf, p, x, y, key=None,
                                     training=False)
+        reg = network_regularization(pconf, p)
+        if policy != "f32":
+            rows, reg = rows.astype(jnp.float32), reg.astype(jnp.float32)
         # dot, not mean: bit-invariant to trailing zero-weight pad rows
         # (see make_finetune_loss / layers.base.rows_broadcast)
         return (jnp.dot(rows, w)
                 / jnp.maximum(jnp.dot(w, jnp.ones_like(w)), 1.0)
-                + network_regularization(conf, params))
+                + reg)
 
     return program

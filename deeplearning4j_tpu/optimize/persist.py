@@ -154,12 +154,24 @@ class PersistentProgramStore:
         return os.path.join(self.directory, name + _SUFFIX)
 
     # -- load ---------------------------------------------------------------
-    def load(self, key: Tuple):
-        """Deserialized `jax.export.Exported` for `key`, or None.
+    def _evict_bad(self, path: str, reason) -> None:
+        """Evict a bad entry (or count a sibling replica beating us to
+        it) — the rewrite is clean either way."""
+        if self._remove(path):
+            self.corrupt_evicted += 1
+            log.warning("compile-cache: evicting bad entry %s (%s)",
+                        os.path.basename(path), reason)
+        else:
+            # a sibling replica evicted (or rewrote) it between our
+            # read and remove — their problem resolved it; plain miss
+            self.vanished += 1
+
+    def _load_payload(self, key: Tuple, payload_kind: str):
+        """Checksum-validated raw blob for `key`, or None.
 
         None covers every miss flavor: absent file, foreign platform,
-        format bump, checksum mismatch, undeserializable blob — the last
-        three also evict the entry so the rewrite is clean."""
+        format bump, payload-kind mismatch, checksum mismatch — the
+        last three also evict the entry so the rewrite is clean."""
         path = self.path_for(key)
         try:
             faults.fire("persist.read", path=path)
@@ -182,21 +194,14 @@ class PersistentProgramStore:
                 raise ValueError("platform fingerprint mismatch")
             if header.get("key") != canonical_key(key):
                 raise ValueError("key collision/mismatch")
+            # pre-payload-field entries are all StableHLO programs
+            if header.get("payload", "stablehlo") != payload_kind:
+                raise ValueError("payload kind mismatch")
             if (header.get("blob_sha256")
                     != hashlib.sha256(blob).hexdigest()):
                 raise ValueError("blob checksum mismatch")
-            from jax import export as jax_export
-
-            exported = jax_export.deserialize(bytearray(blob))
         except Exception as e:  # noqa: BLE001 — any bad entry: evict
-            if self._remove(path):
-                self.corrupt_evicted += 1
-                log.warning("compile-cache: evicting bad entry %s (%s)",
-                            os.path.basename(path), e)
-            else:
-                # a sibling replica evicted (or rewrote) it between our
-                # read and remove — their problem resolved it; plain miss
-                self.vanished += 1
+            self._evict_bad(path, e)
             return None
         # LRU touch: loads refresh recency so hot serve-path entries
         # outlive cold ones under the size cap
@@ -204,7 +209,26 @@ class PersistentProgramStore:
             os.utime(path, None)
         except OSError:
             pass
-        return exported
+        return blob
+
+    def load(self, key: Tuple):
+        """Deserialized `jax.export.Exported` for `key`, or None (an
+        undeserializable blob is evicted like any other bad entry)."""
+        blob = self._load_payload(key, "stablehlo")
+        if blob is None:
+            return None
+        try:
+            from jax import export as jax_export
+
+            return jax_export.deserialize(bytearray(blob))
+        except Exception as e:  # noqa: BLE001 — any bad entry: evict
+            self._evict_bad(self.path_for(key), e)
+            return None
+
+    def load_bytes(self, key: Tuple) -> Optional[bytes]:
+        """Opaque byte artifact stored with `store_bytes`, or None —
+        same validation, eviction, and LRU-touch path as programs."""
+        return self._load_payload(key, "bytes")
 
     # -- store --------------------------------------------------------------
     def store(self, key: Tuple, exported) -> bool:
@@ -213,14 +237,30 @@ class PersistentProgramStore:
         tmpfile + `os.replace` in the same directory: readers never see
         a torn entry, concurrent writers of the same key converge on one
         winner with identical content."""
-        path = self.path_for(key)
         try:
             blob = bytes(exported.serialize())
+        except Exception as e:  # noqa: BLE001 — persistence is best-effort
+            log.warning("compile-cache: failed to persist %s (%s)", key, e)
+            return False
+        return self._store_payload(key, blob, "stablehlo")
+
+    def store_bytes(self, key: Tuple, blob: bytes) -> bool:
+        """Atomically persist an opaque byte artifact (e.g. the int8
+        quantized-weights blob that rides alongside a conf's exported
+        programs) under the same header/checksum/atomic-replace/LRU
+        machinery as program entries."""
+        return self._store_payload(key, bytes(blob), "bytes")
+
+    def _store_payload(self, key: Tuple, blob: bytes,
+                       payload_kind: str) -> bool:
+        path = self.path_for(key)
+        try:
             header = json.dumps({
                 "format": FORMAT_VERSION,
                 "platform_fingerprint": self._fingerprint,
                 "platform": self._platform,
                 "key": canonical_key(key),
+                "payload": payload_kind,
                 "created": time.time(),
                 "blob_sha256": hashlib.sha256(blob).hexdigest(),
             }, sort_keys=True).encode("utf-8")

@@ -1,0 +1,337 @@
+"""Per-conf inference precision policy: f32 / bf16 / int8 serving.
+
+Training stays bit-exact f32; *serving* is where the TPU paper's
+arithmetic actually lives — the systolic MXU is an 8-bit design (Jouppi
+et al., 2017), and quantized serving is the economics the Gemma-on-TPU
+report works through.  This module is the policy layer ROADMAP item 3
+names:
+
+  "f32"   the default — nothing changes, outputs stay bitwise-identical
+          to the pre-policy serve path (the f32 cache key is unchanged).
+  "bf16"  cast-on-load: float params cast to bfloat16 ONCE on the host,
+          every layer's matmul/conv compute dtype flipped to bf16 (the
+          `mixed_matmul` lever in nn/layers/base.py), program output
+          cast back to f32.  Halves weight memory/bandwidth.
+  "int8"  weight-only per-channel symmetric quantization: W-leaves live
+          in HBM as int8 + a per-channel f32 scale, the compiled program
+          dequantizes to bf16 IN-GRAPH right before the matmul (the
+          weight-streaming recipe: int8 over the wire, bf16 in the MXU),
+          activations stay bf16/f32.  Scales are calibrated on a
+          held-out batch by a small clip-ratio grid search minimizing
+          output MSE against the f32 reference.
+
+The policy is a cache-key *dimension* (see optimize/infer_cache.py): it
+joins (entry, conf fingerprint, bucket, sharding) so f32/bf16/int8
+programs coexist in memory and in the persist.py disk store, and
+`quantize_artifact_key` names the quantized-weights blob persisted
+alongside the exported StableHLO.
+
+`error_budget_report()` is the eval harness: every zoo model under
+every policy, asserted against the declared per-model budgets
+(models/zoo.py `PRECISION_ERROR_BUDGETS`) — the speedup never ships
+blind.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import io
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+#: the serve-path precision policies, weakest-to-strongest compression
+POLICIES = ("f32", "bf16", "int8")
+
+#: clip ratios the int8 calibration grid tries (1.0 = pure abs-max)
+CLIP_GRID = (1.0, 0.999, 0.995, 0.98)
+
+
+def validate_policy(policy: str) -> str:
+    if policy not in POLICIES:
+        raise ValueError(f"unknown precision policy {policy!r} "
+                         f"(choose one of {', '.join(POLICIES)})")
+    return policy
+
+
+def serve_conf(conf, policy: str):
+    """The conf low-precision programs are built against: every layer's
+    compute dtype flipped to bfloat16.  The ORIGINAL conf's fingerprint
+    stays in the cache key — the policy tag is its own key dimension —
+    so the derived conf never leaks into key identity."""
+    if policy == "f32":
+        return conf
+    return conf.with_compute_dtype("bfloat16")
+
+
+def _is_float(leaf) -> bool:
+    return jnp.issubdtype(jnp.asarray(leaf).dtype, jnp.floating)
+
+
+def cast_params_bf16(params) -> tuple:
+    """Cast-on-load: every float leaf to bfloat16 (done ONCE on the
+    host; the cast tree is then an ordinary jit argument)."""
+    return jax.tree_util.tree_map(
+        lambda a: a.astype(jnp.bfloat16) if _is_float(a) else a, params)
+
+
+# -- int8 weight-only quantization ------------------------------------------
+
+def _quantizable(name: str, leaf) -> bool:
+    """Weight-only rule: leaves named W* with >= 2 dims — Dense/LSTM/
+    Embedding/conv `W`, attention `Wqkv`/`Wo`, FFN `W1`/`W2`.  Biases,
+    LN/BN vectors and the positional table `P` stay float."""
+    return (name.startswith("W") and getattr(leaf, "ndim", 0) >= 2
+            and _is_float(leaf))
+
+
+def _channel_axis(w: np.ndarray) -> int:
+    """Per-channel axis: output channels — axis 0 for 4-D conv kernels
+    (OIHW layout), the last axis everywhere else (n_in, n_out)."""
+    return 0 if w.ndim == 4 else w.ndim - 1
+
+
+def _quantize_leaf(leaf, clip: float) -> Dict[str, jnp.ndarray]:
+    w = np.asarray(leaf, np.float32)
+    axis = _channel_axis(w)
+    reduce_axes = tuple(a for a in range(w.ndim) if a != axis)
+    amax = np.max(np.abs(w), axis=reduce_axes, keepdims=True)
+    scale = np.where(amax > 0.0, amax * clip / 127.0, 1.0).astype(np.float32)
+    q = np.clip(np.rint(w / scale), -127, 127).astype(np.int8)
+    return {"q": jnp.asarray(q), "scale": jnp.asarray(scale)}
+
+
+def quantize_params_int8(params, clip: float = 1.0) -> tuple:
+    """Symmetric per-channel weight quantization at a fixed clip ratio:
+    quantizable leaves become `{"q": int8, "scale": f32}` sub-dicts (an
+    ordinary pytree — the cache and mesh placement machinery see
+    nothing special), everything else passes through untouched."""
+    out = []
+    for layer in params:
+        out.append({name: (_quantize_leaf(leaf, clip)
+                           if _quantizable(name, leaf) else leaf)
+                    for name, leaf in layer.items()})
+    return tuple(out)
+
+
+def runtime_params(params, policy: str):
+    """Params as the compiled program consumes them.  f32 and bf16 pass
+    through (bf16 leaves were cast once on the host); int8 sub-dicts are
+    dequantized IN-GRAPH to bf16 — int8 is what crosses HBM, bf16 is
+    what the MXU multiplies — and the remaining float leaves (biases,
+    LN) join them in bf16 so the whole forward computes uniformly."""
+    if policy != "int8":
+        return params
+    cd = jnp.bfloat16
+    out = []
+    for layer in params:
+        d = {}
+        for name, leaf in layer.items():
+            if isinstance(leaf, dict) and "q" in leaf and "scale" in leaf:
+                d[name] = leaf["q"].astype(cd) * leaf["scale"].astype(cd)
+            elif _is_float(leaf):
+                d[name] = leaf.astype(cd)
+            else:
+                d[name] = leaf
+        out.append(d)
+    return tuple(out)
+
+
+def policy_output(conf, params, x, policy: str):
+    """Eager (uncached) forward under `policy`, output cast back to
+    f32.  `params` must already be policy-transformed for bf16/int8 —
+    the calibration/eval reference path, deliberately bypassing the
+    infer cache so measurement never pollutes it."""
+    from deeplearning4j_tpu.nn.multilayer import network_output
+
+    out = network_output(serve_conf(conf, policy),
+                         runtime_params(params, policy), x,
+                         key=None, training=False)
+    return jnp.asarray(out, jnp.float32)
+
+
+def calibrate_int8(conf, params, x,
+                   clip_grid: Tuple[float, ...] = CLIP_GRID):
+    """Grid-search the clip ratio on held-out batch `x`: quantize under
+    each candidate, score output MSE against the f32 reference, keep
+    the argmin.  Returns (qparams, calibration report)."""
+    ref = np.asarray(policy_output(conf, params, x, "f32"))
+    denom = float(np.mean(ref ** 2)) or 1.0
+    best = None
+    for clip in clip_grid:
+        q = quantize_params_int8(params, clip)
+        out = np.asarray(policy_output(conf, q, x, "int8"))
+        mse = float(np.mean((out - ref) ** 2))
+        if best is None or mse < best[1]:
+            best = (q, mse, clip)
+    qparams, mse, clip = best
+    return qparams, {"clip": clip, "mse": mse, "rel_mse": mse / denom,
+                     "calibration_rows": int(x.shape[0])}
+
+
+# -- persistence --------------------------------------------------------------
+
+def params_digest(params) -> str:
+    """Content digest of a params tree (shapes, dtypes, bytes): ties a
+    persisted quantized artifact to the exact weights it came from."""
+    h = hashlib.sha256()
+    for leaf in jax.tree_util.tree_leaves(params):
+        a = np.asarray(leaf)
+        h.update(str(a.shape).encode())
+        h.update(str(a.dtype).encode())
+        h.update(a.tobytes())
+    return h.hexdigest()[:16]
+
+
+def quantize_artifact_key(conf_fingerprint: str, digest: str) -> tuple:
+    """Disk-store key for a persisted int8 weight artifact — same
+    keyspace as the exported-StableHLO entries, distinct leading tag."""
+    return ("quantized-weights", "int8", conf_fingerprint, digest)
+
+
+def pack_quantized(qparams, report: Optional[dict] = None) -> bytes:
+    """Serialize a quantized params tree (+ its calibration report) to
+    one npz blob for `PersistentProgramStore.store_bytes`."""
+    arrays = {}
+    for i, layer in enumerate(qparams):
+        for name, leaf in layer.items():
+            if isinstance(leaf, dict) and "q" in leaf and "scale" in leaf:
+                arrays[f"{i}|{name}|q"] = np.asarray(leaf["q"])
+                arrays[f"{i}|{name}|s"] = np.asarray(leaf["scale"])
+            else:
+                arrays[f"{i}|{name}|f"] = np.asarray(leaf)
+    import json
+
+    buf = io.BytesIO()
+    np.savez(buf, n_layers=np.asarray(len(qparams), np.int64),
+             report=np.frombuffer(
+                 json.dumps(report or {}).encode(), np.uint8),
+             **arrays)
+    return buf.getvalue()
+
+
+def unpack_quantized(blob: bytes):
+    """Inverse of `pack_quantized`: (qparams tree, calibration report)."""
+    import json
+
+    with np.load(io.BytesIO(blob)) as z:
+        n = int(z["n_layers"])
+        report = json.loads(bytes(z["report"].tobytes()).decode() or "{}")
+        layers = [dict() for _ in range(n)]
+        for key in z.files:
+            if "|" not in key:
+                continue
+            i, name, kind = key.split("|")
+            d = layers[int(i)]
+            if kind == "f":
+                d[name] = jnp.asarray(z[key])
+            else:
+                slot = d.setdefault(name, {})
+                slot["q" if kind == "q" else "scale"] = jnp.asarray(z[key])
+    return tuple(layers), report
+
+
+# -- calibration data + eval harness -----------------------------------------
+
+def default_calibration(conf, rows: int = 32, seed: int = 0):
+    """Deterministic held-out batch shaped for the conf's first layer:
+    integer token ids for EMBEDDING stacks, [rows, T, n_in] for
+    recurrent stacks, flat [rows, n_in] otherwise (a leading
+    `ff_to_conv` preprocessor names the flat width for conv stacks)."""
+    from deeplearning4j_tpu.nn.conf import LayerType
+
+    rng = np.random.RandomState(seed)
+    c0 = conf.conf(0)
+    lt = LayerType(str(c0.layer_type))
+    if lt == LayerType.EMBEDDING:
+        seq = min(int(getattr(c0, "max_seq_len", 0) or 16), 32)
+        return jnp.asarray(rng.randint(0, c0.n_in, size=(rows, seq)),
+                           jnp.int32)
+    if lt in (LayerType.LSTM, LayerType.GRAVES_LSTM):
+        return jnp.asarray(rng.rand(rows, 8, c0.n_in), jnp.float32)
+    n_in = int(c0.n_in)
+    pre = dict(conf.input_preprocessors or ())
+    spec = str(pre.get(0, ""))
+    if spec.startswith("ff_to_conv"):
+        dims = [int(d) for d in spec.split(":")[1:]]
+        n_in = int(np.prod(dims)) if dims else n_in
+    return jnp.asarray(rng.rand(rows, n_in), jnp.float32)
+
+
+def accuracy_delta(conf, params, x, policy: str, qparams=None) -> dict:
+    """Measured delta between a policy's outputs and the f32 reference
+    on batch `x`: top-1 agreement (classifiers) plus (relative) MSE —
+    reconstruction heads budget on rel_mse, softmax heads on
+    top1_delta."""
+    validate_policy(policy)
+    ref = np.asarray(policy_output(conf, params, x, "f32"))
+    if policy == "f32":
+        out = ref
+    elif policy == "bf16":
+        out = np.asarray(
+            policy_output(conf, cast_params_bf16(params), x, "bf16"))
+    else:
+        if qparams is None:
+            qparams, _ = calibrate_int8(conf, params, x)
+        out = np.asarray(policy_output(conf, qparams, x, "int8"))
+    mse = float(np.mean((out - ref) ** 2))
+    denom = float(np.mean(ref ** 2)) or 1.0
+    agree = float(np.mean(out.argmax(-1) == ref.argmax(-1)))
+    return {"policy": policy, "rows": int(x.shape[0]),
+            "top1_agreement": agree, "top1_delta": round(1.0 - agree, 6),
+            "mse": mse, "rel_mse": mse / denom,
+            "max_abs_err": float(np.max(np.abs(out - ref)))}
+
+
+def error_budget_report(small: bool = True, seed: int = 0,
+                        policies: Tuple[str, ...] = ("bf16", "int8")) -> dict:
+    """The eval harness: every zoo model in `precision_eval_confs`
+    under every policy, measured against the declared per-model budgets
+    (`zoo.PRECISION_ERROR_BUDGETS`).  Deterministic on CPU — seeded
+    init, seeded data, eager forwards only (the infer cache is never
+    touched)."""
+    from deeplearning4j_tpu.models import zoo
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+    report = {}
+    for name, conf in zoo.precision_eval_confs(small=small).items():
+        net = MultiLayerNetwork(conf, seed=seed).init()
+        # calibration and eval batches are disjoint (held-out scales)
+        x_eval = default_calibration(conf, rows=64 if small else 256,
+                                     seed=seed + 1)
+        budgets = zoo.PRECISION_ERROR_BUDGETS.get(name, {})
+        entry = {}
+        for policy in policies:
+            qparams = None
+            if policy == "int8":
+                qparams, _ = calibrate_int8(
+                    conf, net.params,
+                    default_calibration(conf, rows=32, seed=seed + 2))
+            delta = accuracy_delta(conf, net.params, x_eval, policy,
+                                   qparams=qparams)
+            budget = dict(budgets.get(policy, {}))
+            delta["budget"] = budget
+            delta["within_budget"] = all(delta[k] <= v
+                                         for k, v in budget.items())
+            entry[policy] = delta
+        report[name] = entry
+    return report
+
+
+def assert_error_budgets(report: Optional[dict] = None) -> dict:
+    """Raise if any model/policy pair exceeds its declared budget."""
+    if report is None:
+        report = error_budget_report()
+    bad = []
+    for model, entry in report.items():
+        for policy, delta in entry.items():
+            if not delta["within_budget"]:
+                bad.append(f"{model}/{policy}: budget {delta['budget']} "
+                           f"vs top1_delta={delta['top1_delta']:.4f} "
+                           f"rel_mse={delta['rel_mse']:.3e}")
+    if bad:
+        raise AssertionError("precision error budget exceeded:\n"
+                             + "\n".join(bad))
+    return report

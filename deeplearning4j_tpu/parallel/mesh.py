@@ -99,5 +99,19 @@ def serve_mesh(devices=None) -> Mesh:
 
 def infer_shardings(mesh: Mesh):
     """(replicated params sharding, row-sharded batch sharding) for an
-    inference mesh — the two placements every serve-path program uses."""
+    inference mesh — the two placements every serve-path program uses.
+
+    The replicated entry is applied to every leaf of the params subtree
+    by the cache's abstract-arg builder, so it covers low-precision
+    params as-is: a bf16-cast tree, and the int8 policy's nested
+    `{"q", "scale"}` sub-dicts (optimize/quantize.py), replicate leaf
+    by leaf — which is how the precision policy composes with the mesh
+    sharding tag in the cache key without any placement special-casing."""
     return NamedSharding(mesh, P()), NamedSharding(mesh, P(SERVE_AXIS))
+
+
+def serve_placements(mesh: Mesh, n_batch_args: int):
+    """(params sharding, batch shardings...) tuple shaped for an N-batch-
+    arg serve entry point — `InferCache._shardings` in tuple form."""
+    rep, batch = infer_shardings(mesh)
+    return (rep,) + (batch,) * int(n_batch_args)

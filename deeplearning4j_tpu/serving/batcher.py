@@ -134,7 +134,11 @@ class MicroBatcher:
         self._rows_done = 0
         self._batch_hist: Dict[int, int] = {}   # flushed batch rows -> count
         self._latencies: Deque[float] = deque(maxlen=4096)  # seconds
-        self._recent: Deque[Tuple[float, int]] = deque()    # (t_done, rows)
+        # (t_done, rows, policy): the precision policy is recorded per
+        # flush at execute time, so per-policy rows/s stays honest when
+        # the operator flips `set_serve_precision` mid-flight
+        self._recent: Deque[Tuple[float, int, str]] = deque()
+        self._rows_by_policy: Dict[str, int] = {}   # cumulative rows
         self._deadline_misses = 0   # requests evicted past their deadline
         self._errors = 0            # requests answered with an exception
         self._degraded_batches = 0  # batches served by the eager fallback
@@ -363,6 +367,7 @@ class MicroBatcher:
                 err = err if err is not None else e
                 out = None
         t_done = time.monotonic()
+        policy = self.net.infer_cache.policy
         offset = 0
         for r in batch:
             if err is not None:
@@ -375,8 +380,10 @@ class MicroBatcher:
             rows = sum(r.rows for r in batch)
             self._reqs_done += len(batch)
             self._rows_done += rows
+            self._rows_by_policy[policy] = (
+                self._rows_by_policy.get(policy, 0) + rows)
             self._batch_hist[rows] = self._batch_hist.get(rows, 0) + 1
-            self._recent.append((t_done, rows))
+            self._recent.append((t_done, rows, policy))
             while self._recent and t_done - self._recent[0][0] > RATE_WINDOW_S:
                 self._recent.popleft()
             for r in batch:
@@ -417,9 +424,14 @@ class MicroBatcher:
         with self._cv:
             lat = sorted(self._latencies)
             now = time.monotonic()
-            recent_rows = sum(r for t, r in self._recent
-                              if now - t <= RATE_WINDOW_S)
+            recent_rows = 0
+            recent_by_policy: Dict[str, int] = {}
+            for t, r, pol in self._recent:
+                if now - t <= RATE_WINDOW_S:
+                    recent_rows += r
+                    recent_by_policy[pol] = recent_by_policy.get(pol, 0) + r
             window = min(max(now - self._t_start, 1e-9), RATE_WINDOW_S)
+            rows_by_policy = dict(self._rows_by_policy)
             depth = self._pending
             reqs, rows = self._reqs_done, self._rows_done
             hist = {str(k): v for k, v in sorted(self._batch_hist.items())}
@@ -463,6 +475,18 @@ class MicroBatcher:
             },
             "fresh_compiles": cache.misses,
             "cache": cache.as_dict(),
+            # active serve-precision policy + per-policy throughput and
+            # the accuracy delta measured at set_serve_precision time
+            # (serving has no labels — the delta can't be measured here)
+            "precision": {
+                "policy": self.net.infer_cache.policy,
+                "rows_by_policy": rows_by_policy,
+                "rows_per_sec_by_policy": {
+                    p: round(r / window, 2)
+                    for p, r in sorted(recent_by_policy.items())},
+                "report": getattr(self.net, "serve_precision_report",
+                                  {"policy": "f32"}),
+            },
             "deadline_misses": deadline_misses,
             "errors": errors,
             "degraded_batches": degraded_batches,

@@ -153,6 +153,27 @@ def replica_metrics(stats: dict, page: Optional[PrometheusText] = None,
     p.gauge("dl4j_serving_inflight",
             "HTTP predict handlers currently in flight.",
             stats.get("inflight", 0), lbl())
+    # serve-precision policy: an info-style gauge names the active
+    # policy, per-policy row counters split throughput, and the
+    # accuracy delta measured at set_serve_precision time rides along —
+    # all label-compatible with the router's `replica` re-export
+    prec = stats.get("precision", {})
+    policy = prec.get("policy", "f32")
+    p.gauge("dl4j_serving_precision_policy_info",
+            "Active serve-precision policy (info-style gauge: the value "
+            "is always 1, the policy is the label).",
+            1, lbl(policy=policy))
+    for pol, rows in sorted(prec.get("rows_by_policy", {}).items()):
+        p.counter("dl4j_serving_policy_rows_total",
+                  "Feature rows served per precision policy.",
+                  rows, lbl(policy=pol))
+    delta = (prec.get("report", {}) or {}).get("accuracy_delta") or {}
+    for metric in ("top1_delta", "rel_mse"):
+        if metric in delta:
+            p.gauge("dl4j_serving_precision_accuracy_delta",
+                    "Measured accuracy delta vs the f32 reference on the "
+                    "held-out batch (by metric).",
+                    delta[metric], lbl(policy=policy, metric=metric))
     prios = stats.get("priorities", {})
     for prio, ps in sorted(prios.items()):
         p.gauge("dl4j_serving_queue_depth",
@@ -166,7 +187,7 @@ def replica_metrics(stats: dict, page: Optional[PrometheusText] = None,
             p.histogram("dl4j_serving_request_latency_seconds",
                         "Enqueue-to-answer latency of successful requests.",
                         h["bounds"], h["counts"], h["inf"], h["sum"],
-                        h["count"], lbl(priority=prio))
+                        h["count"], lbl(priority=prio, policy=policy))
     counts, inf, bsum, bcount = _batch_rows_histogram(
         stats.get("batch_rows_hist", {}))
     p.histogram("dl4j_serving_batch_rows",
@@ -194,16 +215,16 @@ def replica_metrics(stats: dict, page: Optional[PrometheusText] = None,
     cache = stats.get("cache", {})
     p.counter("dl4j_serving_cache_hits_total",
               "Infer-cache in-memory program hits.",
-              cache.get("hits", 0), lbl())
+              cache.get("hits", 0), lbl(policy=policy))
     p.counter("dl4j_serving_cache_misses_total",
               "Infer-cache misses (fresh compiles; 0 on a warmed server).",
-              cache.get("misses", 0), lbl())
+              cache.get("misses", 0), lbl(policy=policy))
     p.counter("dl4j_serving_cache_disk_hits_total",
               "Programs restored from the persistent disk cache.",
-              cache.get("disk_hits", 0), lbl())
+              cache.get("disk_hits", 0), lbl(policy=policy))
     p.counter("dl4j_serving_cache_io_errors_total",
               "Disk-cache I/O errors downgraded to misses.",
-              cache.get("io_errors", 0), lbl())
+              cache.get("io_errors", 0), lbl(policy=policy))
     return p.render() if own_page else ""
 
 
@@ -234,6 +255,10 @@ def router_metrics(stats: dict) -> str:
     p.counter("dl4j_router_unroutable_total",
               "Requests answered 503: no routable replica.",
               stats.get("unroutable", 0))
+    for pol, rows in sorted(stats.get("rows_by_policy", {}).items()):
+        p.counter("dl4j_router_policy_rows_total",
+                  "Fleet-wide feature rows served per precision policy, "
+                  "aggregated over replicas.", rows, {"policy": pol})
     from deeplearning4j_tpu.reliability import CircuitBreaker
     for rep in stats.get("replicas", []):
         rl = {"replica": str(rep.get("index"))}

@@ -365,6 +365,16 @@ class Router:
             }
         out["replicas"] = [r.describe() for r in self.replicas]
         out["healthy_replicas"] = self.healthy_count()
+        # fleet-wide per-precision-policy rows, aggregated from each
+        # replica's last-polled /v1/stats precision block (the
+        # policy-labeled Prometheus re-export keeps the per-replica
+        # split; this is the one-number fleet view)
+        rows_by_policy: dict = {}
+        for rep in out["replicas"]:
+            prec = (rep.get("stats") or {}).get("precision") or {}
+            for pol, rows in prec.get("rows_by_policy", {}).items():
+                rows_by_policy[pol] = rows_by_policy.get(pol, 0) + int(rows)
+        out["rows_by_policy"] = rows_by_policy
         return out
 
     # -- lifecycle ------------------------------------------------------------

@@ -228,6 +228,10 @@ class ModelServer:
             out["draining"] = self._draining
             out["inflight"] = self._inflight
         out["drain_timeout_s"] = self.drain_timeout_s
+        # resident compiled programs by every cache-key dimension —
+        # operators verify warmup coverage (did the warmed programs
+        # carry the right bucket/sharding/policy?) from one scrape
+        out["programs"] = self.net.infer_cache.programs_summary()
         store = self.net.infer_cache.persist
         if store is not None:
             out["compile_cache_dir"] = store.directory

@@ -1,0 +1,66 @@
+"""Dtype-policy guard (ISSUE 8 satellite): importing the whole package
+must never flip `jax_enable_x64`, and no module may pin a float64 array
+at module scope — the serve-precision policies (f32/bf16/int8) assume
+float32 is the ceiling everywhere, and a stray x64 flip would silently
+double every program's memory and invalidate the compile caches.
+
+Tier-1: CPU-only, import-time checks.
+"""
+
+import importlib
+import pkgutil
+
+import jax
+import numpy as np
+
+import deeplearning4j_tpu
+
+
+def _walk_modules():
+    names = ["deeplearning4j_tpu"]
+    for info in pkgutil.walk_packages(deeplearning4j_tpu.__path__,
+                                      prefix="deeplearning4j_tpu."):
+        names.append(info.name)
+    return sorted(names)
+
+
+def _import_all():
+    mods = []
+    for name in _walk_modules():
+        try:
+            mods.append(importlib.import_module(name))
+        except ImportError:
+            # optional-dependency module (gated native/plotting extras):
+            # absent deps are fine, flipped dtype policy is not
+            continue
+        assert not jax.config.jax_enable_x64, (
+            f"importing {name} flipped jax_enable_x64")
+    return mods
+
+
+def test_importing_every_module_leaves_x64_off():
+    mods = _import_all()
+    assert len(mods) > 30  # the walk actually covered the package
+    assert not jax.config.jax_enable_x64
+
+
+def test_no_module_level_float64_arrays():
+    """Module-scope constants (lookup tables, init grids) must be
+    float32 or narrower so they never widen a traced program."""
+    def is_f64(v):
+        return (isinstance(v, (np.ndarray, np.generic))
+                and v.dtype == np.float64) or (
+            isinstance(v, jax.Array) and v.dtype == jax.numpy.float64)
+
+    offenders = []
+    for mod in _import_all():
+        for attr, value in vars(mod).items():
+            if attr.startswith("__"):
+                continue
+            values = (list(value.values()) if isinstance(value, dict)
+                      else list(value) if isinstance(value, (list, tuple))
+                      else [value])
+            for v in values:
+                if is_f64(v):
+                    offenders.append(f"{mod.__name__}.{attr}")
+    assert not offenders, offenders

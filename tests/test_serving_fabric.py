@@ -180,6 +180,91 @@ def test_parser_rejects_malformed_lines():
         parse_prometheus_text("ok_metric 1\nok_metric 2\n")  # dup series
 
 
+# -- serve-precision policy on the wire (ISSUE 8) ----------------------------
+
+def test_precision_policy_metrics_and_label_conformance():
+    """The policy shows up as an info gauge, a per-policy row counter,
+    and a `policy=` label on the cache/latency families — while the
+    pre-existing request/batch families keep their exact label sets."""
+    net = _net()
+    net.set_serve_precision("int8", measure=False)
+    net.warmup([4])
+    server = net.serve(max_delay_ms=1.0)
+    try:
+        code, body = _http(server.url + "/v1/predict",
+                           {"features": _x(2, seed=1).tolist()})
+        assert code == 200, body
+        code, text = _http(server.url + "/metrics")
+        assert code == 200
+        parsed = parse_prometheus_text(text)
+        assert parsed["dl4j_serving_precision_policy_info"][
+            (("policy", "int8"),)] == 1
+        assert parsed["dl4j_serving_policy_rows_total"][
+            (("policy", "int8"),)] >= 2
+        for fam in ("dl4j_serving_cache_hits_total",
+                    "dl4j_serving_cache_misses_total",
+                    "dl4j_serving_cache_disk_hits_total",
+                    "dl4j_serving_cache_io_errors_total"):
+            assert set(parsed[fam]) == {(("policy", "int8"),)}, fam
+        for lbl in parsed["dl4j_serving_request_latency_seconds_count"]:
+            d = dict(lbl)
+            assert d["policy"] == "int8" and d["priority"] in PRIORITIES
+        # unchanged families: priority-only requests, unlabeled batch rows
+        assert set(parsed["dl4j_serving_requests_total"]) == {
+            (("priority", "interactive"),), (("priority", "batch"),)}
+        assert set(parsed["dl4j_serving_batch_rows_count"]) == {()}
+    finally:
+        server.stop()
+
+
+def test_stats_programs_block_lists_policy_tuples():
+    net = _net()
+    net.warmup([4])
+    net.set_serve_precision("bf16", measure=False)
+    net.warmup([4])
+    server = net.serve(max_delay_ms=1.0)
+    try:
+        code, body = _http(server.url + "/v1/stats")
+        assert code == 200
+        st = json.loads(body)
+        rows = {(p["entry"], p["bucket"], p["sharding"], p["policy"])
+                for p in st["programs"]}
+        assert ("output", 4, "single", "f32") in rows
+        assert ("output", 4, "single", "bf16") in rows
+        assert st["precision"]["policy"] == "bf16"
+    finally:
+        server.stop()
+
+
+def test_router_preserves_policy_label_and_aggregates_rows():
+    nets = [_net(seed=0), _net(seed=0)]
+    for n in nets:
+        n.set_serve_precision("bf16", measure=False)
+        n.warmup([4])
+    servers = [n.serve(max_delay_ms=1.0) for n in nets]
+    router = Router([s.url for s in servers],
+                    poll_interval_s=3600.0).start()
+    try:
+        for i in range(4):
+            code, body = _http(router.url + "/v1/predict",
+                               {"features": _x(2, seed=i).tolist()})
+            assert code == 200, body
+        router.poll_once()
+        st = router.stats()
+        assert st["rows_by_policy"] == {"bf16": 8}
+        parsed = parse_prometheus_text(router_metrics(st))
+        assert parsed["dl4j_router_policy_rows_total"][
+            (("policy", "bf16"),)] == 8
+        # replica re-export keeps the policy label alongside `replica`
+        info = parsed["dl4j_serving_precision_policy_info"]
+        assert {dict(lbl)["policy"] for lbl in info} == {"bf16"}
+        assert {dict(lbl)["replica"] for lbl in info} == {"0", "1"}
+    finally:
+        router.stop()
+        for s in servers:
+            s.stop()
+
+
 # -- router over in-process ModelServers -------------------------------------
 
 def _start_pair(poll_interval_s=0.1):
