@@ -497,7 +497,8 @@ def bench_transformer_mfu(devs) -> None:
     conf = _mixed(char_transformer(vocab, d_model=d_model, n_blocks=blocks,
                                    n_heads=heads, max_seq_len=seq,
                                    sparse_labels=True, fused_updater=True,
-                                   attention_block_skip=True))
+                                   attention_block_skip=True,
+                                   attention_fused_bwd=True))
     net = MultiLayerNetwork(conf, seed=0).init()
     trainer = DataParallelTrainer(net, mesh, mode="sync")
 
@@ -539,10 +540,25 @@ def bench_transformer_mfu(devs) -> None:
     # breakdown rides the metric line so every artifact shows WHERE the
     # step spends, not just the headline utilization
     totals = profiling.compiled_totals(compiled)
+    # at this config auto dispatches dense attention (scores fit HBM), so
+    # the backward is XLA autodiff -> "dense" accounting; the fused-bwd
+    # flag stays on so any flash dispatch (longer S, smaller HBM) takes
+    # the fused kernels — bench_attention_fused_bwd times that path
     costs = profiling.transformer_step_costs(
         batch=batch, seq=seq, d_model=d_model, n_blocks=blocks, vocab=vocab,
-        n_params=n_params, dtype_bytes=2, sparse_labels=True)
+        n_params=n_params, dtype_bytes=2, sparse_labels=True,
+        attention_bwd_mode="dense")
     op_breakdown = profiling.breakdown(costs, totals, step_seconds=dt_step)
+    # satellite cross-check: the analytic attention-bwd flops vs XLA's own
+    # executable total — rides the metric line so a chip run can spot an
+    # accounting drift without re-deriving anything
+    attention_bwd_check = {
+        "analytic_flops": costs["attention_bwd"].flops,
+        "measured_total_flops": totals["flops"] if totals else None,
+        "share_of_measured": (round(
+            costs["attention_bwd"].flops / totals["flops"], 4)
+            if totals and totals["flops"] else None),
+    }
     if totals is not None:
         # XLA counts fwd+bwd of the compiled program directly (no remat
         # here, so the compiled-program count is the model count)
@@ -559,14 +575,212 @@ def bench_transformer_mfu(devs) -> None:
               tokens_per_sec=round(tokens / dt_step, 1),
               compile_seconds=round(compile_s, 1),
               op_breakdown=op_breakdown,
+              attention_bwd_check=attention_bwd_check,
               config=f"d{d_model}xL{blocks}xS{seq}xB{batch} bf16 "
-                     "sparse-labels fused-updater block-skip")
+                     "sparse-labels fused-updater block-skip fused-bwd")
     else:
         _emit("charTransformer train FLOPs/sec", achieved, "FLOP/s", None,
               device_kind=devs[0].device_kind,
               tokens_per_sec=round(tokens / dt_step, 1),
               compile_seconds=round(compile_s, 1),
-              op_breakdown=op_breakdown)
+              op_breakdown=op_breakdown,
+              attention_bwd_check=attention_bwd_check)
+
+
+# ---------------------------------------------------------------------------
+# attention — fused-bwd kernels + measured auto-crossover (MFU round 2)
+# ---------------------------------------------------------------------------
+
+def _timed_calls(fn, args, reps: int) -> float:
+    """Steady-state seconds/call: one compile+warm call, then a timed loop
+    closed by a host read (same honesty fence as every other bench)."""
+    _host_sync(fn(*args))
+    t0 = time.perf_counter()
+    out = None
+    for _ in range(reps):
+        out = fn(*args)
+    _host_sync(out)
+    return (time.perf_counter() - t0) / reps
+
+
+def bench_attention_fused_bwd(devs) -> None:
+    """Fused flash backward vs the jax-level recompute VJP it replaces.
+
+    Two levels: (1) raw kernel microbench — flash fwd alone, grad with
+    `fused_bwd=True` (delta + dK/dV + dQ Pallas kernels) and with
+    `fused_bwd=False` (blockwise recompute VJP); (2) a charTransformer
+    train step through the compiled step cache with `attention_impl`
+    pinned to flash, fused on vs off.  vs_baseline on both lines is
+    recompute_time / fused_time (>1 = fused faster) — the acceptance gate
+    is that the fused path is no slower.  The analytic attention-bwd
+    flops for both modes ride along, showing the recompute term
+    (4 extra S*d flops per token per block) eliminated.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.nd.pallas_kernels import (flash_attention,
+                                                      pick_attention_blocks)
+    from deeplearning4j_tpu.nd.platform import is_tpu
+    from deeplearning4j_tpu.optimize import profiling
+
+    B, S, H, D = (2, 64, 2, 8) if SMALL else (4, 1024, 8, 64)
+    reps = 2 if SMALL else 10
+    rng = np.random.RandomState(0)
+    q, k, v, g = (jnp.asarray(rng.randn(B, S, H, D).astype(np.float32))
+                  for _ in range(4))
+    bq, bk = pick_attention_blocks(S, D)
+    # on the CPU fallback, pin interpret so the FUSED kernels are what
+    # gets timed (auto-detect would take the jax-level fallback there and
+    # this arm would time recompute vs recompute)
+    interp = None if is_tpu() else True
+
+    def make_grad(fused):
+        def loss(q, k, v):
+            o = flash_attention(q, k, v, True, bq, bk, interpret=interp,
+                                block_skip=True, fused_bwd=fused)
+            return jnp.sum(o * g)
+
+        return jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
+
+    fwd = jax.jit(lambda q, k, v: flash_attention(q, k, v, True, bq, bk,
+                                                  interpret=interp,
+                                                  block_skip=True))
+    fwd_s = _timed_calls(fwd, (q, k, v), reps)
+    fused_s = _timed_calls(make_grad(True), (q, k, v), reps)
+    recomp_s = _timed_calls(make_grad(False), (q, k, v), reps)
+    _emit("attention fused-bwd kernel grad", fused_s * 1e3, "ms",
+          recomp_s / max(fused_s, 1e-12),
+          fwd_ms=round(fwd_s * 1e3, 3),
+          recompute_bwd_ms=round(recomp_s * 1e3, 3),
+          shape=f"B{B}xS{S}xH{H}xD{D} causal block-skip",
+          blocks_fwd=[bq, bk],
+          blocks_bwd=list(pick_attention_blocks(S, D, bwd=True)),
+          interpret=bool(interp),
+          baseline_note="vs_baseline = recompute-bwd / fused-bwd grad "
+                        "time (>1 = fused faster); interpret=true means "
+                        "emulated kernels on the CPU fallback — only the "
+                        "TPU number scores the fused path")
+
+    from deeplearning4j_tpu.models.zoo import char_transformer
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+    vocab, d_model, blocks, heads, seq, batch = (
+        (32, 32, 1, 2, 32, 4) if SMALL else (64, 128, 2, 4, 128, 8))
+    steps = 2 if SMALL else 10
+    ids = rng.randint(0, vocab, (batch, seq + 1))
+    x = jnp.asarray(ids[:, :-1], jnp.int32)
+    y = jnp.asarray(ids[:, 1:].reshape(batch * seq), jnp.int32)
+
+    def build(fused):
+        conf = char_transformer(vocab, d_model=d_model, n_blocks=blocks,
+                                n_heads=heads, max_seq_len=seq,
+                                sparse_labels=True,
+                                attention_block_skip=True,
+                                attention_fused_bwd=fused)
+        # pin flash so the fused-vs-recompute bwd is what gets timed
+        # (auto never picks flash at these shapes, by design)
+        conf = conf.replace(confs=tuple(c.replace(attention_impl="flash")
+                                        for c in conf.confs))
+        net = MultiLayerNetwork(conf, seed=0).init()
+        net.finetune(x, y)  # compile once through the step cache
+        _host_sync(net.params)
+        return net
+
+    def steady(net):
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            net.finetune(x, y)
+        _host_sync(net.params)
+        return (time.perf_counter() - t0) / steps
+
+    # compile both before timing either; interleave rounds and keep the
+    # min so drift/ordering can't masquerade as a kernel difference
+    net_fused, net_recomp = build(True), build(False)
+    fused_step = min(steady(net_fused), steady(net_fused))
+    recomp_step = min(steady(net_recomp), steady(net_recomp))
+    fused_step = min(fused_step, steady(net_fused))
+    recomp_step = min(recomp_step, steady(net_recomp))
+    n_params_proxy = d_model * d_model * 12 * blocks + d_model * vocab
+    mode_flops = {
+        mode: profiling.transformer_step_costs(
+            batch=batch, seq=seq, d_model=d_model, n_blocks=blocks,
+            vocab=vocab, n_params=n_params_proxy, sparse_labels=True,
+            attention_bwd_mode=mode)["attention_bwd"].flops
+        for mode in ("fused", "recompute")}
+    _emit("attention fused-bwd train step", fused_step * 1e3, "ms/step",
+          recomp_step / max(fused_step, 1e-12),
+          recompute_ms_per_step=round(recomp_step * 1e3, 2),
+          config=f"d{d_model}xL{blocks}xS{seq}xB{batch} flash block-skip",
+          attention_bwd_flops_fused=mode_flops["fused"],
+          attention_bwd_flops_recompute=mode_flops["recompute"],
+          baseline_note="vs_baseline = recompute-bwd / fused-bwd step "
+                        "time (>1 = fused faster); flops extras show the "
+                        "recompute term the fused path eliminates. On the "
+                        "CPU fallback both arms take the jax-level VJP "
+                        "(fused kernels are TPU-gated) so ~1.0 is "
+                        "expected there — only the TPU number scores the "
+                        "fused step")
+
+
+def bench_attention_crossover(devs) -> None:
+    """Measured `attention_impl="auto"` crossover: full vs flash, forward
+    and gradient, over an S sweep — the data the analytic score-bytes
+    bound in nn/layers/attention.py (8 GiB, halved per flash-side
+    improvement) gets checked against on the next chip run.  Metric value
+    is the first swept S where flash wins the gradient; 0 = full won the
+    whole sweep (crossover beyond it)."""
+    import jax
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.nd.attention import full_attention
+    from deeplearning4j_tpu.nd.pallas_kernels import (flash_attention,
+                                                      pick_attention_blocks)
+
+    B, H, D = (1, 2, 8) if SMALL else (2, 8, 64)
+    seqs = (32, 64) if SMALL else (256, 512, 1024)
+    reps = 2 if SMALL else 8
+    rng = np.random.RandomState(0)
+    rows = []
+    crossover_fwd = crossover_grad = 0
+    for S in seqs:
+        q, k, v, g = (jnp.asarray(rng.randn(B, S, H, D).astype(np.float32))
+                      for _ in range(4))
+        bq, bk = pick_attention_blocks(S, D)
+
+        def flash_f(q, k, v, bq=bq, bk=bk):
+            return flash_attention(q, k, v, True, bq, bk, block_skip=True,
+                                   fused_bwd=True)
+
+        def full_f(q, k, v):
+            return full_attention(q, k, v, causal=True)
+
+        def grad_of(fn, g=g):
+            return jax.jit(jax.grad(
+                lambda q, k, v: jnp.sum(fn(q, k, v) * g),
+                argnums=(0, 1, 2)))
+
+        t_full_fwd = _timed_calls(jax.jit(full_f), (q, k, v), reps)
+        t_flash_fwd = _timed_calls(jax.jit(flash_f), (q, k, v), reps)
+        t_full_grad = _timed_calls(grad_of(full_f), (q, k, v), reps)
+        t_flash_grad = _timed_calls(grad_of(flash_f), (q, k, v), reps)
+        rows.append({"seq": S,
+                     "full_fwd_ms": round(t_full_fwd * 1e3, 3),
+                     "flash_fwd_ms": round(t_flash_fwd * 1e3, 3),
+                     "full_grad_ms": round(t_full_grad * 1e3, 3),
+                     "flash_grad_ms": round(t_flash_grad * 1e3, 3),
+                     "scores_bytes": 4 * B * H * S * S})
+        if not crossover_fwd and t_flash_fwd < t_full_fwd:
+            crossover_fwd = S
+        if not crossover_grad and t_flash_grad < t_full_grad:
+            crossover_grad = S
+    _emit("attention auto-crossover sweep", crossover_grad, "seq", None,
+          crossover_fwd_seq=crossover_fwd,
+          sweep=rows, shape=f"B{B}xH{H}xD{D} causal fused-bwd block-skip",
+          analytic_bound_bytes=2 << 30,  # block-skip + fused-bwd halvings
+          baseline_note="value = first swept S where flash grad wins "
+                        "(0 = full won the sweep); checks the auto bound "
+                        "in nn/layers/attention.py against data")
 
 
 # ---------------------------------------------------------------------------
@@ -1176,7 +1390,9 @@ BENCHES = [bench_lenet, bench_char_lstm, bench_vgg_cifar10, bench_word2vec,
            bench_char_lstm4, bench_step_cache, bench_infer_latency,
            bench_serve, bench_serve_precision, bench_serve_router,
            bench_prefetch,
-           bench_cold_start, bench_north_star_cli, bench_transformer_mfu]
+           bench_cold_start, bench_north_star_cli,
+           bench_attention_fused_bwd, bench_attention_crossover,
+           bench_transformer_mfu]
 BASELINE_FIVE = {"bench_lenet", "bench_char_lstm", "bench_vgg_cifar10",
                  "bench_word2vec", "bench_dp_allreduce"}
 

@@ -231,7 +231,8 @@ def char_transformer(vocab: int, d_model: int = 128, n_blocks: int = 2,
                      lr: float = 1e-3, iterations: int = 1,
                      updater: str = "adam", sparse_labels: bool = False,
                      fused_updater: bool = False,
-                     attention_block_skip: bool = False
+                     attention_block_skip: bool = False,
+                     attention_fused_bwd: bool = False
                      ) -> MultiLayerConfiguration:
     """Decoder-only char transformer LM (new scope — the reference's only
     sequence model is the scalar-loop LSTM).  Embedding (+ learned
@@ -239,12 +240,15 @@ def char_transformer(vocab: int, d_model: int = 128, n_blocks: int = 2,
     Trains with Adam by default (the flagship wants it; plain SGD+momentum
     trains transformers poorly).
 
-    The three keyword flags are the MFU-campaign hot-path switches (all
+    The keyword flags are the MFU-campaign hot-path switches (all
     value-preserving; see tests/test_mfu_paths.py): `sparse_labels` trains
     against int class-id targets via the mcxent gather path,
-    `fused_updater` runs the optimizer on flat buffers, and
+    `fused_updater` runs the optimizer on flat buffers,
     `attention_block_skip` drops mask arithmetic on fully-causal flash
-    tiles."""
+    tiles, and `attention_fused_bwd` replaces the flash backward's forward
+    recompute with fused Pallas dK/dV + dQ kernels over saved logsumexp
+    residuals (allclose rather than bitwise; training-only — never an
+    infer-cache key)."""
     b = _base(lr=lr, iters=iterations, updater=updater,
               fused_updater=fused_updater)
     confs = [b.replace(layer_type=LayerType.EMBEDDING, n_in=vocab,
@@ -252,7 +256,8 @@ def char_transformer(vocab: int, d_model: int = 128, n_blocks: int = 2,
     for _ in range(n_blocks):
         confs.append(b.replace(layer_type=LayerType.ATTENTION, n_in=d_model,
                                n_out=d_model, n_heads=n_heads, causal=True,
-                               attention_block_skip=attention_block_skip))
+                               attention_block_skip=attention_block_skip,
+                               attention_fused_bwd=attention_fused_bwd))
         confs.append(b.replace(layer_type=LayerType.TRANSFORMER_FFN,
                                n_in=d_model, n_out=d_model))
     confs.append(b.replace(layer_type=LayerType.OUTPUT, n_in=d_model,

@@ -56,36 +56,41 @@ def _interpret(flag: Optional[bool]) -> bool:
     return not is_tpu()
 
 
-# Measured block-size table for the flash kernel, keyed by (seq, head_dim).
+# Measured block-size table for the flash kernels, keyed by (seq, head_dim).
 # Provenance: TPU v5 lite sweeps at BENCH_r02 shapes (block pairs within the
 # 16 MiB VMEM budget; larger K blocks amortize the loop overhead at long S,
 # larger Q blocks stop paying once the per-tile [block_q, block_k] f32
-# scores tile crowds out double-buffered K/V).  Entries not present fall
+# scores tile crowds out double-buffered K/V).  Values are
+# (fwd_q, fwd_k, bwd_q, bwd_k): the backward kernels keep more live state
+# per tile (q, dO, k, v plus two [block_q, block_k] f32 intermediates —
+# p and ds) so their sizes sit one notch smaller.  Entries not present fall
 # back to the heuristic below; re-run bench_transformer_mfu on new shapes
 # to extend the table.
 _BLOCK_TABLE = {
-    (256, 32): (128, 128),
-    (256, 64): (128, 128),
-    (512, 64): (128, 256),
-    (1024, 64): (128, 256),
-    (1024, 128): (128, 256),
-    (2048, 64): (256, 256),
-    (2048, 128): (256, 256),
-    (4096, 128): (256, 512),
+    (256, 32): (128, 128, 128, 128),
+    (256, 64): (128, 128, 128, 128),
+    (512, 64): (128, 256, 128, 128),
+    (1024, 64): (128, 256, 128, 256),
+    (1024, 128): (128, 256, 128, 128),
+    (2048, 64): (256, 256, 128, 256),
+    (2048, 128): (256, 256, 128, 128),
+    (4096, 128): (256, 512, 128, 256),
 }
 
 
-def pick_attention_blocks(seq: int, head_dim: int) -> tuple:
+def pick_attention_blocks(seq: int, head_dim: int, bwd: bool = False) -> tuple:
     """(block_q, block_k) for `flash_attention` at this (S, head_dim).
 
     Table hit -> measured sizes; miss -> largest power-of-two blocks that
-    divide S (the kernel requires S % block == 0; ragged S falls back to
+    divide S (the kernels require S % block == 0; ragged S falls back to
     `blockwise_attention` anyway), capped at 256/512 to stay inside VMEM
-    with f32 scores tiles.
+    with f32 scores tiles.  `bwd=True` returns the backward kernels' sizes,
+    capped one notch lower (128/256) because the dK/dV and dQ kernels hold
+    two [block_q, block_k] f32 intermediates (p and ds) live per tile.
     """
     hit = _BLOCK_TABLE.get((seq, head_dim))
     if hit is not None:
-        return hit
+        return hit[2:] if bwd else hit[:2]
 
     def fit(cap):
         b = 8
@@ -93,18 +98,25 @@ def pick_attention_blocks(seq: int, head_dim: int) -> tuple:
             b *= 2
         return b
 
-    return (fit(256), fit(512)) if seq % 8 == 0 else (128, 128)
+    caps = (128, 256) if bwd else (256, 512)
+    return (fit(caps[0]), fit(caps[1])) if seq % 8 == 0 else (128, 128)
 
 
 # ---------------------------------------------------------------- attention
 
-def _flash_attn_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int,
+def _flash_attn_kernel(q_ref, k_ref, v_ref, o_ref, *lse_out, block_k: int,
                        causal: bool, q_block: int, scale: float,
                        block_skip: bool = False):
     """One Q tile vs all KV tiles, online softmax in VMEM.
 
     q_ref: [block_q, D]; k_ref/v_ref: [S, D]; o_ref: [block_q, D].
     Grid: (BH, num_q_blocks) — batch*heads is grid dim 0.
+
+    When invoked with a second output ref (`lse_out`, [block_q, 1]) the
+    kernel also emits the per-row logsumexp `m + log(l)` — the softmax
+    normalizer residual the fused backward needs to rebuild probabilities
+    as `p = exp(s - lse)` without re-running the forward.  The o output is
+    computed identically either way.
 
     `block_skip` (causal only) splits the KV loop at the diagonal: tiles
     strictly below it need no mask at all (every kpos < every qpos, so
@@ -160,11 +172,14 @@ def _flash_attn_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int,
         carry = lax.fori_loop(0, nk, make_body(False), carry)
     o, m, l = carry
     o_ref[:] = (o / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+    if lse_out:
+        lse_out[0][:] = m + jnp.log(jnp.maximum(l, 1e-30))
 
 
 def _flash_attention_fwd_impl(q, k, v, causal: bool, block_q: int,
                               block_k: int, interpret: Optional[bool],
-                              block_skip: bool = False):
+                              block_skip: bool = False,
+                              with_lse: bool = False):
     b, s, h, d = q.shape
     bh = b * h
     # [B,S,H,D] -> [BH,S,D]
@@ -175,51 +190,326 @@ def _flash_attention_fwd_impl(q, k, v, causal: bool, block_q: int,
     block_k = min(block_k, s)
     if s % block_q or s % block_k:
         # ragged sequence: stay on the jax-level blockwise path
-        return blockwise_attention(q, k, v, block_size=block_k, causal=causal)
+        out = blockwise_attention(q, k, v, block_size=block_k, causal=causal)
+        return (out, None) if with_lse else out
     grid = (bh, s // block_q)
     scale = 1.0 / (d ** 0.5)
     kernel = functools.partial(_flash_attn_kernel, block_k=block_k,
                                causal=causal, q_block=block_q, scale=scale,
                                block_skip=block_skip and causal)
+    q_spec = pl.BlockSpec((None, block_q, d), lambda i, j: (i, j, 0))
+    kv_spec = pl.BlockSpec((None, s, d), lambda i, j: (i, 0, 0))
+    if with_lse:
+        # logsumexp residual rides along in the kernels' [BH, S, 1] layout
+        # (trailing singleton keeps every ref 2-D for TPU tiling)
+        out, lse = pl.pallas_call(
+            kernel,
+            out_shape=(jax.ShapeDtypeStruct((bh, s, d), q.dtype),
+                       jax.ShapeDtypeStruct((bh, s, 1), jnp.float32)),
+            grid=grid,
+            in_specs=[q_spec, kv_spec, kv_spec],
+            out_specs=(q_spec,
+                       pl.BlockSpec((None, block_q, 1),
+                                    lambda i, j: (i, j, 0))),
+            interpret=_interpret(interpret),
+        )(qr, kr, vr)
+        return out.reshape(b, h, s, d).transpose(0, 2, 1, 3), lse
     out = pl.pallas_call(
         kernel,
         out_shape=jax.ShapeDtypeStruct((bh, s, d), q.dtype),
         grid=grid,
-        in_specs=[
-            pl.BlockSpec((None, block_q, d), lambda i, j: (i, j, 0)),
-            pl.BlockSpec((None, s, d), lambda i, j: (i, 0, 0)),
-            pl.BlockSpec((None, s, d), lambda i, j: (i, 0, 0)),
-        ],
-        out_specs=pl.BlockSpec((None, block_q, d), lambda i, j: (i, j, 0)),
+        in_specs=[q_spec, kv_spec, kv_spec],
+        out_specs=q_spec,
         interpret=_interpret(interpret),
     )(qr, kr, vr)
     return out.reshape(b, h, s, d).transpose(0, 2, 1, 3)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+# ------------------------------------------------------- fused flash bwd
+
+def _flash_bwd_delta_kernel(o_ref, do_ref, delta_ref):
+    """delta = rowsum(dO ∘ O): the softmax-grad correction term.
+
+    One cheap fused pass shared by the dK/dV and dQ kernels (each would
+    otherwise re-derive it per tile).  o_ref/do_ref: [block, D];
+    delta_ref: [block, 1] f32.
+    """
+    delta_ref[:] = jnp.sum(o_ref[:].astype(jnp.float32)
+                           * do_ref[:].astype(jnp.float32),
+                           axis=1, keepdims=True)
+
+
+def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                          dk_ref, dv_ref, *, q_block: int, causal: bool,
+                          block_k: int, scale: float,
+                          block_skip: bool = False):
+    """One K/V tile vs all Q tiles: accumulate dK and dV.
+
+    k_ref/v_ref: [block_k, D] (this grid step's tile); q_ref/do_ref: [S, D];
+    lse_ref/delta_ref: [S, 1] f32.  Grid: (BH, num_k_blocks).
+
+    Probabilities are rebuilt from the saved logsumexp (p = exp(s - lse)) —
+    no softmax recompute, no forward re-run, no [S, S] intermediate.  The
+    causal bounds mirror the forward's: q tiles that end before this k
+    tile's first key are fully masked and skipped outright (always, not
+    just under block_skip — they contribute exact zeros), and `block_skip`
+    additionally splits the loop at the first fully-unmasked q tile so the
+    unmasked majority skips the iota/compare/select (value-identity there,
+    same argument as the forward).
+    """
+    ki = pl.program_id(1)
+    s_total = q_ref.shape[0]
+    d = q_ref.shape[1]
+    nq = s_total // q_block
+    k = k_ref[:].astype(jnp.float32)
+    v = v_ref[:].astype(jnp.float32)
+
+    def make_body(masked):
+        def body(i, carry):
+            dk, dv = carry
+            q = q_ref[pl.ds(i * q_block, q_block), :].astype(jnp.float32)
+            do = do_ref[pl.ds(i * q_block, q_block), :].astype(jnp.float32)
+            lse = lse_ref[pl.ds(i * q_block, q_block), :]
+            delta = delta_ref[pl.ds(i * q_block, q_block), :]
+            s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
+            if masked:
+                qpos = i * q_block + lax.broadcasted_iota(
+                    jnp.int32, (q_block, block_k), 0)
+                kpos = ki * block_k + lax.broadcasted_iota(
+                    jnp.int32, (q_block, block_k), 1)
+                s = jnp.where(kpos <= qpos, s, _NEG_BIG)
+            p = jnp.exp(s - lse)
+            dv_new = dv + jnp.dot(p.T, do, preferred_element_type=jnp.float32)
+            dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
+            ds = p * (dp - delta) * scale
+            dk_new = dk + jnp.dot(ds.T, q, preferred_element_type=jnp.float32)
+            return dk_new, dv_new
+
+        return body
+
+    carry = (jnp.zeros((block_k, d), jnp.float32),
+             jnp.zeros((block_k, d), jnp.float32))
+    if causal:
+        # q tiles whose last row precedes this k tile's first key are
+        # entirely above the diagonal: exact-zero contribution, skip
+        q_start = (ki * block_k) // q_block
+        if block_skip:
+            # q tile i is fully unmasked iff its first row i*q_block is at
+            # or past the tile's last key (ki+1)*block_k - 1
+            q_full = lax.min(
+                ((ki + 1) * block_k - 1 + q_block - 1) // q_block, nq)
+            carry = lax.fori_loop(q_start, q_full, make_body(True), carry)
+            carry = lax.fori_loop(q_full, nq, make_body(False), carry)
+        else:
+            carry = lax.fori_loop(q_start, nq, make_body(True), carry)
+    else:
+        carry = lax.fori_loop(0, nq, make_body(False), carry)
+    dk, dv = carry
+    dk_ref[:] = dk.astype(dk_ref.dtype)
+    dv_ref[:] = dv.astype(dv_ref.dtype)
+
+
+def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                         dq_ref, *, block_k: int, causal: bool,
+                         q_block: int, scale: float,
+                         block_skip: bool = False):
+    """One Q tile vs all K/V tiles: accumulate dQ.
+
+    q_ref/do_ref: [block_q, D] (this grid step's tile); k_ref/v_ref: [S, D];
+    lse_ref/delta_ref: [block_q, 1] f32.  Grid: (BH, num_q_blocks).  The
+    loop bounds are exactly the forward's (`nk_needed`, and `nk_full` under
+    block_skip).
+    """
+    qi = pl.program_id(1)
+    s_total = k_ref.shape[0]
+    d = k_ref.shape[1]
+    nk = s_total // block_k
+    q = q_ref[:].astype(jnp.float32)
+    do = do_ref[:].astype(jnp.float32)
+    lse = lse_ref[:]
+    delta = delta_ref[:]
+
+    def make_body(masked):
+        def body(j, dq):
+            k = k_ref[pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+            v = v_ref[pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+            s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
+            if masked:
+                qpos = qi * q_block + lax.broadcasted_iota(
+                    jnp.int32, (q_block, block_k), 0)
+                kpos = j * block_k + lax.broadcasted_iota(
+                    jnp.int32, (q_block, block_k), 1)
+                s = jnp.where(kpos <= qpos, s, _NEG_BIG)
+            p = jnp.exp(s - lse)
+            dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
+            ds = p * (dp - delta) * scale
+            return dq + jnp.dot(ds, k, preferred_element_type=jnp.float32)
+
+        return body
+
+    dq = jnp.zeros((q_block, d), jnp.float32)
+    if causal:
+        nk_needed = lax.min(((qi + 1) * q_block + block_k - 1) // block_k,
+                            nk)
+        if block_skip:
+            nk_full = (qi * q_block) // block_k
+            dq = lax.fori_loop(0, nk_full, make_body(False), dq)
+            dq = lax.fori_loop(nk_full, nk_needed, make_body(True), dq)
+        else:
+            dq = lax.fori_loop(0, nk_needed, make_body(True), dq)
+    else:
+        dq = lax.fori_loop(0, nk, make_body(False), dq)
+    dq_ref[:] = dq.astype(dq_ref.dtype)
+
+
+def _fused_bwd_blocks(seq: int, head_dim: int, block_q_bwd: int,
+                      block_k_bwd: int):
+    """Resolve backward tile sizes; None when no size divides S (ragged S
+    keeps the jax-level fallback — same rule as the forward)."""
+    pq, pk = pick_attention_blocks(seq, head_dim, bwd=True)
+    bq = min(block_q_bwd or pq, seq)
+    bk = min(block_k_bwd or pk, seq)
+    if seq % bq or seq % bk:
+        return None
+    return bq, bk
+
+
+def _flash_fused_bwd_impl(q, k, v, out, lse, g, causal, block_q, block_k,
+                          interpret, block_skip):
+    """Fused flash backward: delta precompute, then dK/dV and dQ kernels.
+
+    `block_q`/`block_k` are the *backward* tile sizes (see
+    `pick_attention_blocks(..., bwd=True)`); `lse` arrives in the kernels'
+    [BH, S, 1] layout straight from the forward.
+    """
+    b, s, h, d = q.shape
+    bh = b * h
+
+    def to_bh(t):
+        return t.transpose(0, 2, 1, 3).reshape(bh, s, d)
+
+    qr, kr, vr, orr, gr = to_bh(q), to_bh(k), to_bh(v), to_bh(out), to_bh(g)
+    interp = _interpret(interpret)
+    scale = 1.0 / (d ** 0.5)
+    tile_q = pl.BlockSpec((None, block_q, d), lambda i, j: (i, j, 0))
+    tile_k = pl.BlockSpec((None, block_k, d), lambda i, j: (i, j, 0))
+    full_sd = pl.BlockSpec((None, s, d), lambda i, j: (i, 0, 0))
+    tile_r = pl.BlockSpec((None, block_q, 1), lambda i, j: (i, j, 0))
+    full_r = pl.BlockSpec((None, s, 1), lambda i, j: (i, 0, 0))
+
+    delta = pl.pallas_call(
+        _flash_bwd_delta_kernel,
+        out_shape=jax.ShapeDtypeStruct((bh, s, 1), jnp.float32),
+        grid=(bh, s // block_q),
+        in_specs=[tile_q, tile_q],
+        out_specs=tile_r,
+        interpret=interp,
+    )(orr, gr)
+
+    dkv_kernel = functools.partial(
+        _flash_bwd_dkv_kernel, q_block=block_q, causal=causal,
+        block_k=block_k, scale=scale, block_skip=block_skip)
+    dk, dv = pl.pallas_call(
+        dkv_kernel,
+        out_shape=(jax.ShapeDtypeStruct((bh, s, d), k.dtype),
+                   jax.ShapeDtypeStruct((bh, s, d), v.dtype)),
+        grid=(bh, s // block_k),
+        in_specs=[full_sd, tile_k, tile_k, full_sd, full_r, full_r],
+        out_specs=(tile_k, tile_k),
+        interpret=interp,
+    )(qr, kr, vr, gr, lse, delta)
+
+    dq_kernel = functools.partial(
+        _flash_bwd_dq_kernel, block_k=block_k, causal=causal,
+        q_block=block_q, scale=scale, block_skip=block_skip)
+    dq = pl.pallas_call(
+        dq_kernel,
+        out_shape=jax.ShapeDtypeStruct((bh, s, d), q.dtype),
+        grid=(bh, s // block_q),
+        in_specs=[tile_q, full_sd, full_sd, tile_q, tile_r, tile_r],
+        out_specs=tile_q,
+        interpret=interp,
+    )(qr, kr, vr, gr, lse, delta)
+
+    def from_bh(t):
+        return t.reshape(b, h, s, d).transpose(0, 2, 1, 3)
+
+    return from_bh(dq), from_bh(dk), from_bh(dv)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9, 10))
 def flash_attention(q, k, v, causal: bool = False, block_q: int = 128,
                     block_k: int = 128, interpret: Optional[bool] = None,
-                    block_skip: bool = False):
-    """Flash attention: [B,S,H,D] inputs, Pallas forward, recompute backward.
+                    block_skip: bool = False, fused_bwd: bool = False,
+                    block_q_bwd: int = 0, block_k_bwd: int = 0):
+    """Flash attention: [B,S,H,D] inputs, Pallas forward, optional fused
+    Pallas backward.
 
-    Backward recomputes attention blockwise (flash-style memory profile) via
-    the jax-level implementation's VJP, so grads never materialize [S,S]
-    either.  `block_skip=True` (causal only) splits the kernel's KV loop at
-    the diagonal so fully-unmasked tiles skip the mask arithmetic — same
-    values, fewer VPU ops; see `_flash_attn_kernel`.
+    `fused_bwd=False` (default): backward recomputes attention blockwise
+    (flash-style memory profile) via the jax-level implementation's VJP, so
+    grads never materialize [S,S] — but the whole forward is re-derived.
+    `fused_bwd=True`: the forward additionally saves per-row logsumexp
+    residuals and the backward runs three Pallas kernels (delta precompute,
+    dK/dV with a k-tile outer loop, dQ with a q-tile outer loop) that
+    rebuild probabilities tile-by-tile from the residuals — no forward
+    re-run, still no [S,S].  `block_q_bwd`/`block_k_bwd` pin the backward
+    tile sizes (0 -> autotuned via `pick_attention_blocks(..., bwd=True)`).
+    The fused path silently degrades to the jax-level fallback when no
+    backward block divides S, and in auto-detected interpret mode
+    (`interpret=None` off-TPU — emulated kernels lose to XLA's batched
+    scan there; pass `interpret=True` to force the fused kernels on CPU).  `block_skip=True` (causal only) splits every
+    kernel's inner loop at the diagonal so fully-unmasked tiles skip the
+    mask arithmetic — same values, fewer VPU ops; see `_flash_attn_kernel`.
     """
     return _flash_attention_fwd_impl(q, k, v, causal, block_q, block_k,
                                      interpret, block_skip)
 
 
-def _flash_fwd(q, k, v, causal, block_q, block_k, interpret, block_skip):
+def _flash_fwd(q, k, v, causal, block_q, block_k, interpret, block_skip,
+               fused_bwd, block_q_bwd, block_k_bwd):
+    s, d = q.shape[1], q.shape[3]
+    # the fused kernels engage on a real TPU lowering, or when the caller
+    # pinned `interpret` (tests exercise the kernels that way on CPU).
+    # Auto-detected interpret mode (interpret=None off-TPU) keeps the
+    # jax-level recompute fallback: emulated per-tile kernels lose to
+    # XLA's batched blockwise scan on host CPUs, so fusing there would
+    # make the flag a de-optimization exactly where the bench is tagged
+    # cpu_fallback.
+    fused = (fused_bwd
+             and (interpret is not None or is_tpu())
+             and s % min(block_q, s) == 0 and s % min(block_k, s) == 0
+             and _fused_bwd_blocks(s, d, block_q_bwd, block_k_bwd)
+             is not None)
+    if fused:
+        out, lse = _flash_attention_fwd_impl(
+            q, k, v, causal, block_q, block_k, interpret, block_skip,
+            with_lse=True)
+        return out, (q, k, v, out, lse)
     out = _flash_attention_fwd_impl(q, k, v, causal, block_q, block_k,
                                     interpret, block_skip)
-    return out, (q, k, v)
+    # None residuals are static pytree leaves: the backward sees exactly
+    # the pre-fused residual set and stays bitwise-identical
+    return out, (q, k, v, None, None)
 
 
-def _flash_bwd(causal, block_q, block_k, interpret, block_skip, res, g):
-    q, k, v = res
+def _flash_bwd(causal, block_q, block_k, interpret, block_skip, fused_bwd,
+               block_q_bwd, block_k_bwd, res, g):
+    q, k, v, out, lse = res
+    if lse is not None:
+        bq, bk = _fused_bwd_blocks(q.shape[1], q.shape[3],
+                                   block_q_bwd, block_k_bwd)
+        return _flash_fused_bwd_impl(q, k, v, out, lse, g, causal, bq, bk,
+                                     interpret, block_skip and causal)
+    # jax-level fallback (fused_bwd off, ragged S where no Pallas block
+    # divides it, or auto-detected interpret mode — see `_flash_fwd`):
+    # recompute blockwise and take that VJP.  `block_k` is the
+    # caller's pick_attention_blocks choice and the only knob
+    # blockwise_attention has: it processes every query row at once per KV
+    # block, so there is no q tiling for `block_q` to size.  `block_skip`
+    # cannot apply either — the KV loop is a lax.scan whose body must be
+    # uniform across iterations, so the mask select runs on every block
+    # (it is value-identity below the diagonal, which is exactly the no-op
+    # the Pallas kernels' split elides).
     _, vjp = jax.vjp(
         lambda q, k, v: blockwise_attention(q, k, v, block_size=block_k,
                                             causal=causal), q, k, v)

@@ -178,6 +178,12 @@ class NeuralNetConfiguration:
                                    # [rows, vocab] one-hot gemm
     fused_updater: bool = False    # flat-buffer updater step instead of
                                    # O(leaves) per-leaf tree_maps
+    attention_fused_bwd: bool = False  # flash bwd via fused Pallas kernels
+                                   # over saved logsumexp residuals (no
+                                   # fwd recompute); only consulted when
+                                   # the flash impl dispatches — training-
+                                   # only, never an infer-cache key
+                                   # (allclose, not bitwise, vs recompute)
 
     # batch-norm running-stat decay (ema = m*ema + (1-m)*batch)
     batch_norm_momentum: float = 0.9

@@ -67,6 +67,7 @@ class MultiHeadAttentionLayer:
         v = v.reshape(b, s, h, hd)
         blk = conf.attention_block_size
         skip = conf.attention_block_skip and conf.causal
+        fused_bwd = conf.attention_fused_bwd
         impl = conf.attention_impl
         if impl == "auto":
             if is_tpu():
@@ -81,12 +82,21 @@ class MultiHeadAttentionLayer:
                 # per block (8 blocks x 2 GiB at S=1024 runs fine), and b
                 # here is the per-device batch under shard_map. Overrides:
                 # conf.attention_impl pins an impl, conf.remat frees HBM.
-                # With the causal block-skip the flash kernel does ~half the
-                # tile visits, moving the crossover one doubling earlier
-                # (analytic shift off the same v5e sweep; re-measure when a
-                # chip is claimable).
+                # Each flash-side improvement moves the crossover one
+                # doubling earlier (halves the bound): the causal block-skip
+                # halves the kernel's tile visits, and the fused backward
+                # removes the flash path's forward recompute — dense
+                # attention's bwd was ~2x flash-recompute's cost advantage,
+                # so flash now wins a doubling sooner again.  Both shifts
+                # are analytic off the same v5e sweep; bench.py's
+                # bench_attention_crossover records the measured boundary
+                # to check these bounds on the next chip run.
                 scores_bytes = 4 * b * h * s * s  # f32 fwd scores
-                bound = (4 << 30) if skip else (8 << 30)
+                bound = 8 << 30
+                if skip:
+                    bound >>= 1
+                if fused_bwd:
+                    bound >>= 1
                 impl = "full" if scores_bytes <= bound else "flash"
             else:
                 impl = "blockwise" if blk else "full"
@@ -94,8 +104,11 @@ class MultiHeadAttentionLayer:
             from deeplearning4j_tpu.nd.pallas_kernels import (
                 flash_attention, pick_attention_blocks)
             bq, bk = (blk, blk) if blk else pick_attention_blocks(s, hd)
+            # pinned conf block pins the bwd tiles too; 0 -> bwd-aware
+            # autotune inside flash_attention
             o = flash_attention(q, k, v, conf.causal, bq, bk,
-                                block_skip=skip)
+                                block_skip=skip, fused_bwd=fused_bwd,
+                                block_q_bwd=blk, block_k_bwd=blk)
         elif impl == "blockwise":
             o = blockwise_attention(q, k, v, block_size=blk or 512,
                                     causal=conf.causal)

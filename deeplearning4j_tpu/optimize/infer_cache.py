@@ -56,7 +56,8 @@ import jax
 import jax.numpy as jnp
 
 from deeplearning4j_tpu.optimize.step_cache import (CompiledProgramCache,
-                                                    arg_signature)
+                                                    arg_signature,
+                                                    conf_fingerprint)
 
 
 def pad_rows(x, bucket: int):
@@ -108,6 +109,27 @@ class InferCache(CompiledProgramCache):
         # serve-path params are reused by every subsequent call (and by
         # training) — donation would invalidate live buffers
         return ()
+
+    def _fingerprint(self, conf) -> str:
+        # attention_fused_bwd only changes the backward pass: serving
+        # programs are gradient-free, so the flag is normalized out of the
+        # inference fingerprint.  Flipping it for training therefore never
+        # re-keys (or invalidates on-disk) serving programs — the training
+        # step cache keeps the base fingerprint and re-keys as it should.
+        with self._lock:
+            fp = self._fingerprints.get(id(conf))
+            if fp is None:
+                norm = conf
+                confs = getattr(conf, "confs", None)
+                if confs and any(c.attention_fused_bwd for c in confs):
+                    norm = conf.replace(confs=tuple(
+                        c.replace(attention_fused_bwd=False)
+                        for c in confs))
+                elif getattr(conf, "attention_fused_bwd", False):
+                    norm = conf.replace(attention_fused_bwd=False)
+                fp = conf_fingerprint(norm)
+                self._fingerprints[id(conf)] = fp
+            return fp
 
     # -- mesh ----------------------------------------------------------------
     def set_mesh(self, mesh) -> None:

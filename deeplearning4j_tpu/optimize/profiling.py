@@ -4,11 +4,15 @@ MFU alone says *that* a train step is slow, not *where*.  This module
 splits a transformer train step's cost into the op categories the
 campaign's hot-path work targets —
 
-  matmul      weight GEMMs (qkv / proj / ffn / logits), fwd + bwd
-  attention   the S x S score + value products per head, fwd + bwd
-  elementwise layernorm / gelu / softmax / residual traffic
-  updater     the optimizer chain over every parameter
-  transfer    host -> device batch bytes per step
+  matmul         weight GEMMs (qkv / proj / ffn / logits), fwd + bwd
+  attention_fwd  the S x S score + value products per head, forward
+  attention_bwd  the grad products (dQ/dK/dV) — split from fwd so the
+                 fused-bwd campaign leg shows up as its own line, and so
+                 the jax-level recompute path's extra forward is charged
+                 where it belongs
+  elementwise    layernorm / gelu / softmax / residual traffic
+  updater        the optimizer chain over every parameter
+  transfer       host -> device batch bytes per step
 
 — from two independent sources that cross-check each other:
 
@@ -37,7 +41,13 @@ from __future__ import annotations
 import contextlib
 from typing import NamedTuple
 
-CATEGORIES = ("matmul", "attention", "elementwise", "updater", "transfer")
+CATEGORIES = ("matmul", "attention_fwd", "attention_bwd", "elementwise",
+              "updater", "transfer")
+
+#: analytic attention-backward flop multiples of the forward's 4*S*d per
+#: token per block, by backward implementation (see
+#: `transformer_step_costs`)
+ATTENTION_BWD_MODES = ("dense", "fused", "recompute")
 
 
 class OpCost(NamedTuple):
@@ -48,14 +58,29 @@ class OpCost(NamedTuple):
 def transformer_step_costs(*, batch: int, seq: int, d_model: int,
                            n_blocks: int, vocab: int, n_params: int,
                            dtype_bytes: int = 2,
-                           sparse_labels: bool = False) -> dict:
+                           sparse_labels: bool = False,
+                           attention_bwd_mode: str = "dense") -> dict:
     """Analytic per-category costs for ONE char-transformer train step.
 
     Exact pieces (standard dense-transformer accounting):
       matmul GEMM params  P_mm = 12*d^2 per block (qkv 3d^2 + proj d^2 +
       ffn up/down 8d^2) + d*vocab logits; fwd+bwd = 6 * P_mm * tokens.
-      attention = 12 * n_blocks * tokens * seq * d_model (scores + values,
-      2*2*S*d per token per block fwd, x3 for bwd).
+      attention_fwd = 4 * u where u = n_blocks * tokens * seq * d_model
+      (scores 2*S*d + values 2*S*d per token per block).
+      attention_bwd depends on the backward implementation
+      (`attention_bwd_mode`):
+        "dense"     8 * u — XLA autodiff of full/blockwise attention: the
+                    four grad products (dV, dP, dS->dK, dS->dQ) with the
+                    probabilities retained from the forward;
+        "fused"     10 * u — the fused Pallas backward
+                    (`attention_fused_bwd`): same four grad products plus
+                    one in-kernel score recompute (2*u), which is the
+                    price of never materializing [S,S];
+        "recompute" 12 * u — the jax-level fallback VJP: the 8*u autodiff
+                    products plus a full forward re-run (4*u).  This is
+                    the term the fused path eliminates; pre-split
+                    accounting lumped attention at 12*u total and silently
+                    undercounted this path, inflating `unattributed`.
 
     Coarse pieces (coefficients below, documented not derived):
       elementwise: ~60 flops per activation element per block fwd+bwd
@@ -69,20 +94,28 @@ def transformer_step_costs(*, batch: int, seq: int, d_model: int,
     row matrix — the whole point of `sparse_labels` is this vocab-fold
     reduction plus the gathered (never materialized) one-hot in the loss.
     """
+    if attention_bwd_mode not in ATTENTION_BWD_MODES:
+        raise ValueError(f"attention_bwd_mode={attention_bwd_mode!r} not in "
+                         f"{ATTENTION_BWD_MODES}")
     tokens = batch * seq
     p_mm = 12 * n_blocks * d_model * d_model + d_model * vocab
     matmul = OpCost(6.0 * p_mm * tokens,
                     3.0 * p_mm * dtype_bytes)  # weights read fwd+bwd+gradw
-    attention = OpCost(12.0 * n_blocks * tokens * seq * d_model,
-                       # q/k/v/scores read+write per block, fwd+bwd ~ 3x
-                       3.0 * n_blocks * (3 * tokens * d_model
-                                         + batch * seq * seq) * dtype_bytes)
+    attn_unit = float(n_blocks * tokens * seq * d_model)
+    # q/k/v/scores read+write per block: 1x the per-block traffic fwd,
+    # 2x bwd (grads flow back through both products)
+    attn_traffic = (3 * tokens * d_model + batch * seq * seq) * dtype_bytes
+    bwd_mult = {"dense": 8.0, "fused": 10.0, "recompute": 12.0}
+    attention_fwd = OpCost(4.0 * attn_unit, 1.0 * n_blocks * attn_traffic)
+    attention_bwd = OpCost(bwd_mult[attention_bwd_mode] * attn_unit,
+                           2.0 * n_blocks * attn_traffic)
     elementwise = OpCost(60.0 * n_blocks * tokens * d_model,
                          6.0 * n_blocks * tokens * d_model * dtype_bytes)
     updater = OpCost(12.0 * n_params, 7.0 * n_params * 4)
     label_bytes = tokens * (4 if sparse_labels else vocab * dtype_bytes)
     transfer = OpCost(0.0, tokens // max(seq, 1) * seq * 4 + label_bytes)
-    return {"matmul": matmul, "attention": attention,
+    return {"matmul": matmul, "attention_fwd": attention_fwd,
+            "attention_bwd": attention_bwd,
             "elementwise": elementwise, "updater": updater,
             "transfer": transfer}
 
