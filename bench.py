@@ -453,6 +453,72 @@ def bench_dp_allreduce(devs) -> None:
           baseline_note=note)
 
 
+def bench_elastic_resume(devs) -> None:
+    """Cost of crash-resumable mesh training (ISSUE 10): steady-state
+    step time with checkpointing off vs on (one atomic write every 5
+    steps), seconds per checkpoint write, and the restore-and-reshard
+    latency of an elastic N -> N/2 resume."""
+    import shutil
+    import tempfile
+
+    import jax
+
+    from deeplearning4j_tpu.models.zoo import mlp
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.parallel.data_parallel import DataParallelTrainer
+    from deeplearning4j_tpu.parallel.mesh import make_mesh
+
+    batch, steps, every_n = (64, 10, 5) if SMALL else (4096, 40, 5)
+    n_dev = len(devs)
+    mesh = make_mesh({"dp": n_dev})
+    rng = np.random.RandomState(0)
+    x = rng.rand(batch, 784).astype(np.float32)
+    y = np.eye(10, dtype=np.float32)[rng.randint(0, 10, batch)]
+    batches = [(x, y)] * steps
+
+    def run(ckpt_dir, every):
+        net = MultiLayerNetwork(mlp(784, [512, 512], 10), seed=0).init()
+        t = DataParallelTrainer(net, mesh, mode="sync")
+        t.fit(batches[:2], epochs=1)  # compile outside the timed window
+        t0 = time.perf_counter()
+        t.fit(batches, epochs=1, checkpoint_dir=ckpt_dir,
+              checkpoint_every_n_batches=every, auto_resume=False)
+        _host_sync(t.state.params)
+        return (time.perf_counter() - t0) / steps * 1e3, t
+
+    work = tempfile.mkdtemp(prefix="dl4j-bench-elastic-")
+    try:
+        off_ms, _ = run(None, 0)
+        ck = os.path.join(work, "ck")
+        on_ms, trainer = run(ck, every_n)
+        per_write_s = (trainer.checkpoint_write_seconds /
+                       max(trainer.checkpoints_written, 1))
+        _emit("elastic ckpt steady-state step overhead", on_ms - off_ms,
+              "ms/step", off_ms / on_ms,  # ~1 = checkpointing is free
+              n_devices=n_dev, every_n_batches=every_n,
+              step_ms_off=round(off_ms, 3), step_ms_on=round(on_ms, 3),
+              writes=trainer.checkpoints_written,
+              baseline_note="vs_baseline = off/on step-time ratio "
+                            "(1.0 = zero overhead)")
+        _emit("elastic ckpt write time", per_write_s, "s/write", None,
+              n_devices=n_dev)
+
+        # elastic restore: the checkpoint written on n_dev chips re-places
+        # on an n_dev/2 mesh (host materialize + device_put per leaf)
+        half = max(1, n_dev // 2)
+        mesh_half = make_mesh({"dp": half}, devices=jax.devices()[:half])
+        net2 = MultiLayerNetwork(mlp(784, [512, 512], 10), seed=0).init()
+        t2 = DataParallelTrainer(net2, mesh_half, mode="sync")
+        t0 = time.perf_counter()
+        t2.restore(ck)
+        _host_sync(t2.state.params)
+        restore_s = time.perf_counter() - t0
+        _emit("elastic restore+reshard latency", restore_s * 1e3, "ms", None,
+              from_devices=n_dev, to_devices=half)
+    finally:
+        shutil.rmtree(work, ignore_errors=True)
+
+
 # ---------------------------------------------------------------------------
 # flagship — char-transformer MFU
 # ---------------------------------------------------------------------------
@@ -1387,6 +1453,7 @@ def bench_cold_start(devs) -> None:
 # (timeout-shortened) run still captures the five baseline metrics.
 BENCHES = [bench_lenet, bench_char_lstm, bench_vgg_cifar10, bench_word2vec,
            bench_dp_allreduce,
+           bench_elastic_resume,
            bench_char_lstm4, bench_step_cache, bench_infer_latency,
            bench_serve, bench_serve_precision, bench_serve_router,
            bench_prefetch,

@@ -172,11 +172,10 @@ def cmd_train(args) -> int:
             "gradient-only and would silently skip layer-wise pretraining")
     ckpt_dir = getattr(args, "checkpoint_dir", None)
     ckpt_every = int(props.get("checkpoint_every", "10"))
-    if ckpt_dir and args.runtime == "mesh":
-        raise SystemExit(
-            "--checkpoint-dir needs --runtime local: the mesh trainer "
-            "keeps updater state across batches, which the "
-            "params+RNG-key checkpoint does not capture yet")
+    zero1 = bool(getattr(args, "zero1", False))
+    if zero1 and args.runtime != "mesh":
+        raise SystemExit("--zero1 shards updater state over the dp mesh "
+                         "axis; it requires --runtime mesh")
     if ckpt_dir and (deep_ae or conf.pretrain):
         raise SystemExit(
             "--checkpoint-dir does not support pretraining recipes "
@@ -205,6 +204,12 @@ def cmd_train(args) -> int:
         remainder = sum(b.num_examples() % n_dev
                         for b in data.batch_by(batch))
         if remainder:
+            if zero1:
+                raise SystemExit(
+                    f"--zero1 needs every batch divisible by the {n_dev}-"
+                    f"device dp axis ({remainder} examples/epoch are not): "
+                    f"pick a batch size that divides the dataset, or drop "
+                    f"--zero1")
             # remainder batches run through the pad-and-mask step (see
             # DataParallelTrainer._step_padded): every example still
             # trains, at the cost of one extra compiled variant
@@ -212,8 +217,27 @@ def cmd_train(args) -> int:
                   f"path to stay divisible by the {n_dev}-device dp axis",
                   file=sys.stderr)
         trainer = DataParallelTrainer(
-            net, mesh, mode=props.get("mode", "sync"))
-        trainer.fit(data.batch_by(batch), epochs=epochs)
+            net, mesh, mode=props.get("mode", "sync"), zero1=zero1)
+        if ckpt_dir:
+            # crash-safe + elastic: full TrainState (params, updater
+            # moments, step, RNG key, batch cursor) checkpoints through
+            # parallel/checkpoint.py; the saved arrays are gathered, so
+            # a rerun resumes on ANY device count
+            from deeplearning4j_tpu.reliability import TrainingInterrupted
+
+            try:
+                trainer.fit(data.batch_by(batch), epochs=epochs,
+                            checkpoint_dir=ckpt_dir,
+                            checkpoint_every_n_batches=ckpt_every)
+            except TrainingInterrupted as e:
+                print(json.dumps({"interrupted": True,
+                                  "checkpoint": ckpt_dir,
+                                  "detail": str(e)}), flush=True)
+                return 0
+        else:
+            trainer.fit(data.batch_by(batch), epochs=epochs)
+        resumed_from_step = trainer.resumed_from_step
+        ckpt_write_seconds = trainer.checkpoint_write_seconds
         # multi-chip compiles are timed in the trainer's own program
         # cache (track_jit); report those instead of the bypassed
         # single-chip step cache
@@ -285,6 +309,10 @@ def cmd_train(args) -> int:
                     net.fit(data.features,
                             data.features if reconstruction else data.labels)
 
+    if args.runtime != "mesh":
+        # the single-device trainer keeps the same books on the net
+        resumed_from_step = net.resumed_from_batch
+        ckpt_write_seconds = net.checkpoint_write_seconds
     train_seconds = _time.perf_counter() - t_train
     # a reconstruction head's output width is n_in: score against the
     # inputs, not the (differently-shaped) labels
@@ -295,6 +323,9 @@ def cmd_train(args) -> int:
     cs = step_stats  # trainer.compile_cache on mesh, net.step_cache locally
     ic = net.infer_cache.stats  # the final score() above serves from it
     print(json.dumps({"saved": args.output, "score": score,
+                      "resumed_from_step": resumed_from_step,
+                      "checkpoint_write_seconds": round(
+                          ckpt_write_seconds, 3),
                       "train_seconds": round(train_seconds, 3),
                       "examples_per_sec": round(
                           n_trained / max(train_seconds, 1e-9), 2),
@@ -668,6 +699,12 @@ def build_parser() -> argparse.ArgumentParser:
                         "lenet5|mlp|char_lstm[:k=v,...] (e.g. "
                         "char_lstm:layers=4,hidden=128)")
     t.add_argument("--runtime", choices=["local", "mesh"], default="local")
+    t.add_argument("--zero1", action="store_true",
+                   help="ZeRO-1: shard updater (optimizer) state over the "
+                        "dp mesh axis instead of replicating it (needs "
+                        "--runtime mesh and dp-divisible batches); "
+                        "checkpoints gather to full shape, so resume "
+                        "works on any device count")
     t.add_argument("--properties", default=None,
                    help="k=v[,k=v...] train properties: epochs, batch, "
                         "mode, checkpoint_every (batches between "
@@ -675,9 +712,11 @@ def build_parser() -> argparse.ArgumentParser:
     t.add_argument("--checkpoint-dir", dest="checkpoint_dir", default=None,
                    metavar="DIR",
                    help="crash-safe training: checkpoint params + RNG key "
-                        "+ batch cursor here every checkpoint_every "
+                        "+ batch cursor (on mesh, also the full sharded "
+                        "updater state) here every checkpoint_every "
                         "batches and on SIGTERM; rerunning with the same "
-                        "flags auto-resumes at the saved cursor")
+                        "flags auto-resumes at the saved cursor — a mesh "
+                        "checkpoint resumes on any device count")
     t.set_defaults(fn=cmd_train)
 
     te = sub.add_parser("test", help="evaluate a checkpoint")

@@ -341,6 +341,10 @@ class MultiLayerNetwork:
         # SIGTERM/preemption flag: `fit(checkpoint_dir=...)` checks it
         # between batches and checkpoints-then-exits when set
         self._stop_training = threading.Event()
+        # crash-resume bookkeeping, reported by the CLI train JSON
+        self.resumed_from_batch: Optional[int] = None
+        self.checkpoint_write_seconds = 0.0
+        self.checkpoints_written = 0
         # persistent compile cache: DL4J_COMPILE_CACHE=<dir> attaches the
         # on-disk program store to every network in the process, so
         # restarts skip recompiles (the CLI's --compile-cache flag sets
@@ -741,13 +745,18 @@ class MultiLayerNetwork:
         self._stop_training.set()
 
     def _save_checkpoint(self, directory: str, batches_done: int) -> None:
+        import time as _time
+
         from deeplearning4j_tpu.parallel import checkpoint as ckpt
 
+        t0 = _time.perf_counter()
         ckpt.save(directory, self.params, conf=self.conf,
                   step=batches_done,
                   data_cursor={"batches_done": int(batches_done)},
                   metadata={"rng_key": np.asarray(
                       jax.device_get(self._key)).tolist()})
+        self.checkpoint_write_seconds += _time.perf_counter() - t0
+        self.checkpoints_written += 1
 
     def _fit_checkpointed(self, batches, checkpoint_dir: str,
                           every_n: int, auto_resume: bool) -> None:
@@ -765,6 +774,7 @@ class MultiLayerNetwork:
                 rng = (meta.get("metadata") or {}).get("rng_key")
                 if rng is not None:
                     self._key = jnp.asarray(np.asarray(rng, dtype=np.uint32))
+                self.resumed_from_batch = start_batch
                 log.info("fit: auto-resumed %s at batch %d",
                          checkpoint_dir, start_batch)
         self._stop_training.clear()

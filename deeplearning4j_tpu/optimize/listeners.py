@@ -63,6 +63,11 @@ class CheckpointListener(IterationListener):
         if state is not None:
             args = (self.directory, state.params, state.updater)
             kw = dict(conf=conf, step=int(state.step), metadata=meta)
+            mesh_meta = getattr(model, "mesh_meta", None)
+            if callable(mesh_meta):
+                # record the writing topology so a loader can detect an
+                # elastic (N->M device) resume instead of guessing
+                kw["mesh"] = mesh_meta()
         else:
             args = (self.directory, net.params, None)
             kw = dict(conf=conf, step=int(iteration), metadata=meta)

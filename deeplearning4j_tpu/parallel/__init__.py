@@ -18,5 +18,8 @@ Modules:
 from deeplearning4j_tpu.parallel.mesh import make_mesh, mesh_axes
 from deeplearning4j_tpu.parallel.averaging import (average_pytrees, merge,
                                                    ParameterAggregator)
+from deeplearning4j_tpu.parallel.checkpoint import CheckpointFormatError
 from deeplearning4j_tpu.parallel.data_parallel import (DataParallelTrainer,
-                                                       make_dp_train_step)
+                                                       make_dp_train_step,
+                                                       make_zero1_train_step,
+                                                       zero1_shard_state)

@@ -10,11 +10,21 @@ gap out explicitly).
 
 Format: a directory per checkpoint —
   conf.json      model config (portable JSON, reference parity)
-  meta.json      step counter, data cursor, user metadata
+  meta.json      step counter, data cursor, format version, mesh
+                 metadata (axis names / shape / zero1), user metadata
   arrays.npz     every leaf of the state pytree, keyed by tree path
 Writes are atomic (tmp dir + rename) and optionally async (the
 ModelSavingActor ran off-thread too).  Multi-host: only process 0 writes;
-all leaves are gathered to host first (`jax.device_get`).
+all leaves are gathered to host first (`jax.device_get`) — sharded
+(e.g. ZeRO-1) leaves gather to their full global shape, which is what
+makes resume ELASTIC: a checkpoint written on an N-chip mesh holds
+topology-free host arrays that re-place on any M-chip mesh.
+
+Versioning: meta.json carries ``format_version`` (missing = 0, the
+pre-versioning format — still loadable).  A checkpoint from a NEWER
+format, or one whose tree doesn't match the model being restored, fails
+with a one-line `CheckpointFormatError` instead of a KeyError/shape
+explosion deep in jax.
 """
 
 from __future__ import annotations
@@ -34,6 +44,18 @@ from deeplearning4j_tpu.reliability import faults
 
 log = logging.getLogger("deeplearning4j_tpu")
 
+#: current checkpoint format.  0 = the pre-versioning format (no
+#: ``format_version`` key in meta.json); 1 adds the version field and
+#: the ``mesh`` metadata block.  Loading tolerates every version <= this.
+FORMAT_VERSION = 1
+
+
+class CheckpointFormatError(RuntimeError):
+    """The checkpoint exists and is readable, but cannot be restored into
+    this process: newer format version, or a state tree that doesn't
+    match the model (different config/topology).  The message is the
+    one-line actionable diagnosis."""
+
 
 def _flatten_with_paths(tree) -> Dict[str, np.ndarray]:
     flat = {}
@@ -47,8 +69,14 @@ def _flatten_with_paths(tree) -> Dict[str, np.ndarray]:
 
 def save(directory: str, params, updater=None, *, conf=None, step: int = 0,
          data_cursor: Optional[Dict[str, Any]] = None,
-         metadata: Optional[Dict[str, Any]] = None) -> str:
-    """Write an atomic checkpoint; returns the directory path."""
+         metadata: Optional[Dict[str, Any]] = None,
+         mesh: Optional[Dict[str, Any]] = None) -> str:
+    """Write an atomic checkpoint; returns the directory path.
+
+    `mesh` records the writing topology ({"axis_names", "shape",
+    "zero1"}) so a loader can DETECT an N->M resume instead of guessing;
+    the arrays themselves are always saved gathered (global shape), so
+    any topology can re-place them."""
     if jax.process_index() != 0:
         return directory
     faults.fire("checkpoint.save", path=directory)
@@ -63,7 +91,9 @@ def save(directory: str, params, updater=None, *, conf=None, step: int = 0,
         np.savez(os.path.join(tmp, "arrays.npz"),
                  **_flatten_with_paths(state))
         meta = {"step": int(step), "data_cursor": data_cursor or {},
-                "metadata": metadata or {}}
+                "metadata": metadata or {},
+                "format_version": FORMAT_VERSION,
+                "mesh": mesh or None}
         with open(os.path.join(tmp, "meta.json"), "w") as f:
             json.dump(meta, f, indent=1)
         if conf is not None:
@@ -104,19 +134,30 @@ def _raise_pending_async_error() -> None:
     raise err
 
 
+def _host_snapshot(tree):
+    """OWNED host copies of every leaf, taken synchronously.
+
+    `np.asarray(device_get(x))` is NOT enough: on host backends
+    device_get can return a zero-copy VIEW of the live device buffer,
+    and the dp train steps donate the TrainState — by the time the
+    background writer serializes the leaf, the next step may have
+    donated-and-deleted the buffer under the view.  np.array copies."""
+    return jax.tree_util.tree_map(
+        lambda x: np.array(jax.device_get(x)), tree)
+
+
 def save_async(directory: str, params, updater=None, **kw) -> threading.Thread:
-    """Off-thread snapshot (ModelSavingActor behavior): device_get NOW so
-    training can mutate donated buffers, write in the background.
+    """Off-thread snapshot (ModelSavingActor behavior): copy to host NOW
+    so training can donate/mutate the live buffers, write in the
+    background.
 
     Re-raises the exception of any PREVIOUS async save that failed, so a
     dying disk stops the run instead of silently dropping checkpoints;
     `join_async()` flushes and re-raises explicitly."""
     _raise_pending_async_error()
-    params = jax.tree_util.tree_map(lambda x: np.asarray(jax.device_get(x)),
-                                    params)
+    params = _host_snapshot(params)
     if updater is not None:
-        updater = jax.tree_util.tree_map(
-            lambda x: np.asarray(jax.device_get(x)), updater)
+        updater = _host_snapshot(updater)
 
     def run():
         try:
@@ -152,18 +193,46 @@ def load(directory: str, like_params=None, like_updater=None
     tree path is returned.  Returns (params, updater_or_None, meta).
 
     Falls back to '<dir>.bak' when the directory is missing (a crash
-    between save()'s two renames leaves the previous checkpoint there)."""
+    between save()'s two renames leaves the previous checkpoint there).
+
+    Raises `CheckpointFormatError` when the checkpoint's format_version
+    is newer than this build supports, or (with `like_*`) when the saved
+    tree is structurally incompatible with the example pytree — missing
+    leaves or mismatched shapes get a one-line diagnosis instead of a
+    KeyError / downstream shape explosion."""
     if not os.path.isdir(directory) and os.path.isdir(directory + ".bak"):
         directory = directory + ".bak"
+    faults.fire("checkpoint.load", path=directory)
     with np.load(os.path.join(directory, "arrays.npz")) as z:
         flat = {k: z[k] for k in z.files}
     with open(os.path.join(directory, "meta.json")) as f:
         meta = json.load(f)
+    version = int(meta.get("format_version", 0))
+    if version > FORMAT_VERSION:
+        raise CheckpointFormatError(
+            f"checkpoint {directory} has format_version={version} but this "
+            f"build reads <= {FORMAT_VERSION} — upgrade deeplearning4j_tpu "
+            f"(or re-save the checkpoint with the older build)")
 
     def restore(prefix, like):
         paths = jax.tree_util.tree_flatten_with_path(like)
         keys = ["/".join(str(getattr(p, "key", getattr(p, "idx", p)))
                          for p in path) for path, _ in paths[0]]
+        missing = [k for k in keys if f"{prefix}/{k}" not in flat]
+        if missing:
+            raise CheckpointFormatError(
+                f"checkpoint {directory} is missing {len(missing)} "
+                f"'{prefix}' leaves (first: {prefix}/{missing[0]}) — it was "
+                f"written for a different model config; point it at a "
+                f"checkpoint of THIS model or start fresh")
+        for k, (_, leaf) in zip(keys, paths[0]):
+            want = tuple(getattr(leaf, "shape", ()) or ())
+            got = tuple(flat[f"{prefix}/{k}"].shape)
+            if want and got != want:
+                raise CheckpointFormatError(
+                    f"checkpoint {directory} leaf {prefix}/{k} has shape "
+                    f"{got}, model expects {want} — layer sizes differ; "
+                    f"this checkpoint belongs to a different config")
         leaves = [jax.numpy.asarray(flat[f"{prefix}/{k}"]) for k in keys]
         return jax.tree_util.tree_unflatten(paths[1], leaves)
 
@@ -189,13 +258,18 @@ def load_resilient(directory: str, like_params=None, like_updater=None
 
     `load()` only consults the .bak when the main dir is missing; this
     also survives a main dir that exists but is corrupt (torn npz,
-    truncated meta.json) — auto-resume must never crash on a bad
-    checkpoint, just fall back or start fresh."""
+    missing/truncated meta.json) — auto-resume must never crash on a bad
+    checkpoint, just fall back or start fresh.  A `CheckpointFormatError`
+    (newer format / wrong model) is NOT corruption: both candidates were
+    written by the same run, so it propagates with its one-line diagnosis
+    rather than silently restarting training from scratch."""
     for cand in (directory, directory + ".bak"):
         if not os.path.isdir(cand):
             continue
         try:
             return load(cand, like_params, like_updater)
+        except CheckpointFormatError:
+            raise
         except Exception as e:  # noqa: BLE001 — corrupt entry, try fallback
             log.warning("checkpoint %s unreadable (%r); trying fallback",
                         cand, e)

@@ -21,10 +21,14 @@ boundary" of the reference (Hazelcast job slots) becomes ICI collectives.
 
 from __future__ import annotations
 
-from typing import Iterable, NamedTuple
+import signal
+import threading
+import time
+from typing import Iterable, NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from deeplearning4j_tpu.nn.conf import MultiLayerConfiguration
@@ -37,6 +41,7 @@ from deeplearning4j_tpu.optimize.updater import (UpdaterState, adjust_gradient,
                                                  init_updater)
 from deeplearning4j_tpu.parallel.mesh import shard_batch
 from deeplearning4j_tpu.parallel.sequence import _as_varying, _shard_map
+from deeplearning4j_tpu.reliability import TrainingInterrupted, faults
 
 import logging
 
@@ -267,7 +272,7 @@ def zero1_pspecs(tree, mesh: Mesh, axis: str = "dp"):
 
 
 def make_zero1_train_step(conf: MultiLayerConfiguration, mesh: Mesh,
-                          axis: str = "dp"):
+                          axis: str = "dp", cache=None):
     """Data-parallel step with ZeRO-1 optimizer-state sharding, built on
     GSPMD sharding annotations instead of manual collectives: the batch
     is dp-sharded, params stay replicated, and the AdaGrad/momentum (or
@@ -310,7 +315,10 @@ def make_zero1_train_step(conf: MultiLayerConfiguration, mesh: Mesh,
                 p, NamedSharding(mesh, P())), params)
         return TrainState(params, upd, state.step + 1), score
 
-    return jax.jit(step_fn, donate_argnums=(0,))
+    jitted = jax.jit(step_fn, donate_argnums=(0,))
+    if cache is not None:
+        return cache.track_jit(("zero1_step", axis), jitted)
+    return jitted
 
 
 def zero1_shard_state(state: TrainState, mesh: Mesh, axis: str = "dp"):
@@ -485,15 +493,22 @@ class DataParallelTrainer:
 
     mode="sync"      per-step gradient all-reduce (fast path)
     mode="averaging" BSP local-steps-then-average (reference parity)
+    zero1=True       sync mode with ZeRO-1 updater-state sharding: the
+                     adagrad/momentum moments live 1/n_dp per chip
+                     (`make_zero1_train_step`); checkpoints gather them
+                     to full shape on save and re-shard on load, so the
+                     same elastic resume covers them
     """
 
     def __init__(self, net: MultiLayerNetwork, mesh: Mesh,
                  mode: str = "sync", local_steps: int = 5,
-                 axis: str = "dp", listeners=(), grad_accum: int = 1):
+                 axis: str = "dp", listeners=(), grad_accum: int = 1,
+                 zero1: bool = False):
         self.net = net
         self.mesh = mesh
         self.axis = axis
         self.mode = mode
+        self.zero1 = bool(zero1)
         self.listeners = list(listeners)
         if net.params is None:
             net.init()
@@ -504,7 +519,16 @@ class DataParallelTrainer:
 
         self.compile_cache = CompiledProgramCache()
         self.compile_cache.kind = "dp-step-cache"
-        if mode == "sync":
+        if zero1:
+            if mode != "sync":
+                raise ValueError("zero1=True requires mode='sync' (the "
+                                 "averaging round replicates its carry)")
+            if grad_accum > 1:
+                raise ValueError("zero1=True does not compose with "
+                                 "grad_accum yet")
+            self._step = make_zero1_train_step(net.conf, mesh, axis,
+                                               cache=self.compile_cache)
+        elif mode == "sync":
             self._step = make_dp_train_step(net.conf, mesh, axis,
                                             grad_accum=grad_accum,
                                             cache=self.compile_cache)
@@ -520,25 +544,123 @@ class DataParallelTrainer:
         self._grad_accum = grad_accum
         self._masked_step = None  # built lazily on first remainder batch
         self.state = init_train_state(net)
+        if zero1:
+            self.state = zero1_shard_state(self.state, mesh, axis)
         self._key = jax.random.PRNGKey(net.conf.confs[0].seed or 0)
+        # crash-safety bookkeeping (fit(checkpoint_dir=...)): SIGTERM flag
+        # checked between batches, resume provenance, write-cost accounting
+        self._stop_training = threading.Event()
+        self.resumed_from_step: Optional[int] = None
+        self.checkpoint_write_seconds = 0.0
+        self.checkpoints_written = 0
 
     def _next_key(self):
         self._key, sub = jax.random.split(self._key)
         return sub
 
+    # -- checkpoint / elastic resume ----------------------------------------
+    def mesh_meta(self) -> dict:
+        """Topology stamp recorded in every checkpoint this trainer
+        writes: enough for a loader to detect (not guess) an N->M or
+        zero1-flag change on resume."""
+        return {"axis_names": list(self.mesh.axis_names),
+                "shape": {a: int(self.mesh.shape[a])
+                          for a in self.mesh.axis_names},
+                "zero1": self.zero1}
+
+    def _check_mesh_meta(self, meta: dict) -> None:
+        """Compare the checkpoint's recorded topology with THIS mesh and
+        log every difference — elastic resume handles them all (leaves
+        are saved gathered), but silently is how divergence hides."""
+        ck = meta.get("mesh") or {}
+        if not ck:
+            return  # pre-elastic checkpoint: nothing recorded to compare
+        ck_axes = list(ck.get("axis_names") or [])
+        cur_axes = list(self.mesh.axis_names)
+        if ck_axes != cur_axes:
+            log.warning("checkpoint mesh axes %s != current %s; leaves "
+                        "re-place on the current mesh", ck_axes, cur_axes)
+        ck_shape = {k: int(v) for k, v in (ck.get("shape") or {}).items()}
+        cur_shape = {a: int(self.mesh.shape[a]) for a in cur_axes}
+        if ck_shape != cur_shape:
+            log.info("elastic resume: checkpoint written on mesh %s, "
+                     "resuming on %s", ck_shape, cur_shape)
+        if bool(ck.get("zero1", False)) != self.zero1:
+            log.info("checkpoint zero1=%s, trainer zero1=%s: updater "
+                     "state re-places per the current mode",
+                     bool(ck.get("zero1", False)), self.zero1)
+
+    def _place_state(self, state: TrainState) -> TrainState:
+        """Re-place a host-materialized TrainState on THIS trainer's mesh
+        — the elastic half of resume (`get_sharding_tree` pattern): a
+        sharding tree for the NEW mesh re-places every leaf, so a
+        checkpoint written on N chips trains on M.  Params and step
+        replicate; updater state replicates too, or re-shards over the
+        dp axis in zero1 mode."""
+        if self.zero1:
+            return zero1_shard_state(
+                TrainState(params=state.params, updater=state.updater,
+                           step=jnp.asarray(state.step, jnp.int32)),
+                self.mesh, self.axis)
+        rep = NamedSharding(self.mesh, P())
+
+        def put(tree):
+            return jax.tree_util.tree_map(
+                lambda a: jax.device_put(jnp.asarray(a), rep), tree)
+
+        return TrainState(params=put(state.params),
+                          updater=put(state.updater),
+                          step=jax.device_put(
+                              jnp.asarray(state.step, jnp.int32), rep))
+
+    def _apply_restored(self, params, updater, meta: dict) -> None:
+        self._check_mesh_meta(meta)
+        step = int(meta.get("step", 0))
+        self.state = self._place_state(TrainState(
+            params=params, updater=updater,
+            step=jnp.asarray(step, jnp.int32)))
+        self.net.params = jax.tree_util.tree_map(jnp.asarray, params)
+        rng = (meta.get("metadata") or {}).get("rng_key")
+        if rng is not None:
+            # without the key a "resumed" run draws a fresh dropout/shuffle
+            # stream and silently diverges from the uninterrupted one
+            self._key = jnp.asarray(np.asarray(rng, dtype=np.uint32))
+        self.resumed_from_step = step
+
     def restore(self, directory: str) -> int:
-        """Resume from a `CheckpointListener` checkpoint: params, updater
-        state, and step counter land back in TrainState (kill-and-resume).
-        Returns the restored step."""
+        """Resume from a checkpoint: params, updater state, step counter,
+        AND the host RNG key land back in the trainer, re-placed on THIS
+        trainer's mesh (elastic: the writing mesh may have had a
+        different device count).  Returns the restored step."""
         from deeplearning4j_tpu.parallel import checkpoint
 
         params, updater, meta = checkpoint.load(
             directory, like_params=self.state.params,
             like_updater=self.state.updater)
-        self.state = TrainState(params=params, updater=updater,
-                                step=jnp.asarray(meta["step"], jnp.int32))
-        self.net.params = params
+        self._apply_restored(params, updater, meta)
         return int(meta["step"])
+
+    def _save_checkpoint(self, directory: str, batches_done: int) -> None:
+        """Synchronous atomic checkpoint of the COMPLETE cross-batch
+        state: params + updater moments (zero1 shards gather to full
+        shape via device_get) + step + host RNG key + data cursor."""
+        from deeplearning4j_tpu.parallel import checkpoint as ckpt
+
+        t0 = time.perf_counter()
+        ckpt.save(directory, self.state.params, self.state.updater,
+                  conf=self.net.conf, step=int(self.state.step),
+                  data_cursor={"batches_done": int(batches_done)},
+                  metadata={"rng_key": np.asarray(
+                      jax.device_get(self._key)).tolist()},
+                  mesh=self.mesh_meta())
+        self.checkpoint_write_seconds += time.perf_counter() - t0
+        self.checkpoints_written += 1
+
+    def request_stop_training(self) -> None:
+        """Ask a running `fit(checkpoint_dir=...)` to checkpoint and
+        raise `TrainingInterrupted` after the current batch (what the
+        installed SIGTERM handler calls)."""
+        self._stop_training.set()
 
     def _step_padded(self, x, y):
         """Zero-pad a remainder batch to a dp-divisible shape and run the
@@ -574,19 +696,95 @@ class DataParallelTrainer:
         x, y, w = shard_batch(self.mesh, (x, y, w), self.axis)
         return self._masked_step(self.state, x, y, w, self._next_key())
 
-    def fit(self, data: Iterable, epochs: int = 1) -> float:
+    def fit(self, data: Iterable, epochs: int = 1, *,
+            checkpoint_dir: Optional[str] = None,
+            checkpoint_every_n_batches: int = 0,
+            auto_resume: bool = True) -> float:
         """data yields (features, labels) or DataSet; leading dim must be
-        divisible by the dp axis size."""
+        divisible by the dp axis size (remainder batches pad-and-mask;
+        zero1 mode requires divisible batches).
+
+        With `checkpoint_dir` the run is crash-safe AND elastic (ISSUE
+        10): the complete cross-batch state — params, updater moments,
+        step, host RNG key, batch cursor — is checkpointed atomically
+        every `checkpoint_every_n_batches` batches (and at the end), a
+        SIGTERM checkpoints-then-raises `TrainingInterrupted`, and a
+        rerun with the same `checkpoint_dir` and the same batch stream
+        auto-resumes at the saved cursor — on ANY device count: the
+        checkpoint holds gathered host arrays, and resume re-places them
+        on this trainer's mesh (same-topology resume is bit-identical;
+        N->M changes only the f32 reduction grouping of the collectives).
+        The batch cursor counts across epochs, so resume lands mid-epoch
+        correctly."""
+        start_batch = 0
+        if checkpoint_dir is not None and auto_resume:
+            start_batch = self._try_resume(checkpoint_dir)
+        if checkpoint_dir is None:
+            return self._fit_loop(data, epochs, None, 0, 0)
+        self._stop_training.clear()
+        prev_handler, installed = None, False
+        if threading.current_thread() is threading.main_thread():
+            try:
+                prev_handler = signal.signal(
+                    signal.SIGTERM,
+                    lambda signum, frame: self._stop_training.set())
+                installed = True
+            except ValueError:
+                pass  # exotic embedding: no handler, explicit stop only
+        try:
+            return self._fit_loop(data, epochs, checkpoint_dir,
+                                  int(checkpoint_every_n_batches),
+                                  start_batch)
+        finally:
+            if installed:
+                signal.signal(signal.SIGTERM, prev_handler)
+
+    def _try_resume(self, directory: str) -> int:
+        """Restore the newest valid checkpoint under `directory` (or its
+        .bak) into this trainer; returns the batch cursor to skip to (0 =
+        nothing to resume)."""
+        from deeplearning4j_tpu.parallel import checkpoint
+
+        restored = checkpoint.load_resilient(
+            directory, like_params=self.state.params,
+            like_updater=self.state.updater)
+        if restored is None:
+            return 0
+        params, updater, meta = restored
+        self._apply_restored(params, updater, meta)
+        cursor = int((meta.get("data_cursor") or {}).get("batches_done", 0))
+        log.info("mesh fit: auto-resumed %s at batch %d (step %d, mesh %s)",
+                 directory, cursor, self.resumed_from_step,
+                 (meta.get("mesh") or {}).get("shape"))
+        return cursor
+
+    def _fit_loop(self, data, epochs: int, checkpoint_dir: Optional[str],
+                  every_n: int, start_batch: int) -> float:
         score = float("nan")
         n_dp = self.mesh.shape[self.axis]
+        n_done = 0
         for _ in range(epochs):
             if hasattr(data, "reset"):
                 data.reset()
             for batch in data:
+                n_done += 1
+                if n_done <= start_batch:
+                    # replaying the resumed prefix of the stream: the data
+                    # order is deterministic, so skipping (not re-training)
+                    # these batches reproduces the dead run's position; no
+                    # RNG keys are consumed (the restored key already
+                    # accounts for them)
+                    continue
+                faults.fire("trainer.step", batch=n_done)
                 x, y = ((batch.features, batch.labels)
                         if hasattr(batch, "features") else batch)
                 x, y = jnp.asarray(x), jnp.asarray(y)
                 if x.shape[0] % n_dp:
+                    if self.zero1:
+                        raise ValueError(
+                            f"zero1 mode needs batches divisible by the "
+                            f"{n_dp}-wide dp axis, got {x.shape[0]} rows "
+                            f"(resize the batch or drop zero1)")
                     # pad-and-mask: every real sample still contributes
                     # exactly once (no silent remainder drop)
                     self.state, s = self._step_padded(x, y)
@@ -601,6 +799,16 @@ class DataParallelTrainer:
                     for li in self.listeners:
                         li.iteration_done(self, int(self.state.step),
                                           float(s))
+                if checkpoint_dir is not None:
+                    if self._stop_training.is_set():
+                        self._save_checkpoint(checkpoint_dir, n_done)
+                        raise TrainingInterrupted(
+                            f"stop requested: checkpointed {checkpoint_dir}"
+                            f" at batch {n_done}")
+                    if every_n > 0 and n_done % every_n == 0:
+                        self._save_checkpoint(checkpoint_dir, n_done)
+        if checkpoint_dir is not None and n_done > start_batch:
+            self._save_checkpoint(checkpoint_dir, n_done)
         # hand the net a single-device copy: the serve/train-path AOT
         # programs compile for single-chip layouts, and an
         # already-compiled executable can't reshard a mesh-replicated

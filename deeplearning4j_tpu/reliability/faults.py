@@ -19,6 +19,13 @@ Injection points wired into the codebase:
   ``dispatcher.execute``  per coalesced batch in the serving gateway's
                           dispatcher (serving/batcher.py)
   ``checkpoint.save``     atomic checkpoint write (parallel/checkpoint.py)
+  ``checkpoint.load``     checkpoint read (parallel/checkpoint.py) — an
+                          armed raise simulates a torn/unreadable dir,
+                          which `load_resilient` must skip, never crash on
+  ``trainer.step``        per batch in `DataParallelTrainer.fit`
+                          (parallel/data_parallel.py) — an armed raise
+                          "kills" mesh training mid-epoch for the
+                          elastic-resume chaos tests
 
 The registry is generic — tests may `fire()` arbitrary point names of
 their own.  With nothing armed, `fire()` is a counter bump under a lock:
