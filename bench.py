@@ -1264,6 +1264,216 @@ def bench_serve_router(devs) -> None:
 
 
 # ---------------------------------------------------------------------------
+# fleet SLO — open-loop Poisson load vs a supervised fleet, + kill-and-heal
+# ---------------------------------------------------------------------------
+
+def bench_fleet_slo(devs) -> None:
+    """Max sustained rows/s under a fixed p99 SLO, measured OPEN-LOOP
+    (Poisson arrivals, heavy-tailed row mix): closed-loop clients slow
+    down with the server and hide queueing collapse, an open-loop
+    generator keeps offering load and exposes it (the TPU paper's
+    datacenter framing — the fleet is judged at its latency bound, not
+    its best case).  Arms: 1 vs 2 supervised replicas climbing a rate
+    ladder, then a kill-and-heal timeline — SIGKILL one of 2 replicas
+    mid-window and report error count, heal time (supervisor respawn to
+    healthy fleet), and fresh compiles on the respawned replica (0 =
+    the shared disk cache made the restart seconds, not compiles).
+    CPU-bound by design: it measures the fabric, not the chip."""
+    import json as json_mod
+    import random as random_mod
+    import shutil
+    import subprocess
+    import tempfile
+    import urllib.request
+
+    from deeplearning4j_tpu.models.zoo import mlp
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.parallel import checkpoint
+
+    if SMALL:
+        hidden, level_s, rates = [32], 1.0, (20.0, 50.0)
+        heal_s, heal_rate, heal_wait_s = 6.0, 10.0, 20.0
+    else:
+        hidden, level_s, rates = [256], 3.0, (25.0, 50.0, 100.0, 200.0)
+        heal_s, heal_rate, heal_wait_s = 12.0, 25.0, 45.0
+    slo_p99_ms = 250.0
+    n_in = 64
+    #: heavy-tailed row mix: mostly single rows, a tail of coalescable
+    #: bursts — every size pre-warmed so the fleet never compiles
+    row_mix = (1, 1, 1, 1, 1, 1, 2, 2, 4, 8)
+    tmp = tempfile.mkdtemp(prefix="dl4j-bench-fleet-")
+    try:
+        net = MultiLayerNetwork(mlp(n_in, hidden, 10), seed=0).init()
+        ckpt = os.path.join(tmp, "model")
+        cache = os.path.join(tmp, "cache")
+        checkpoint.save(ckpt, net.params, conf=net.conf)
+        env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+        shapes = "1,2,4,8"
+        subprocess.run(
+            [sys.executable, "-m", "deeplearning4j_tpu.cli", "warmup",
+             "--model", ckpt, "--compile-cache", cache, "--shapes", shapes],
+            check=True, capture_output=True, env=env)
+        rng = np.random.RandomState(0)
+        bodies = {
+            rows: json_mod.dumps(
+                {"features": rng.rand(rows, n_in).astype(
+                    np.float32).tolist()}).encode()
+            for rows in sorted(set(row_mix))}
+
+        def open_loop(url, rate_rps, duration_s, seed=0):
+            """Poisson arrivals at `rate_rps` for `duration_s`; every
+            arrival fires regardless of how the fleet is doing (that is
+            the open-loop point).  Returns (rows/s completed, p99 ms,
+            errors, offered requests)."""
+            arr_rng = random_mod.Random(seed)
+            lock = threading.Lock()
+            lat, rows_done, errors, offered = [], [0], [0], [0]
+            threads = []
+
+            def one(body, nrows):
+                t0 = time.perf_counter()
+                try:
+                    req = urllib.request.Request(
+                        url + "/v1/predict", data=body,
+                        headers={"Content-Type": "application/json"})
+                    with urllib.request.urlopen(req, timeout=30) as r:
+                        r.read()
+                    dt = time.perf_counter() - t0
+                    with lock:
+                        lat.append(dt)
+                        rows_done[0] += nrows
+                except Exception:
+                    with lock:
+                        errors[0] += 1
+
+            t_begin = time.perf_counter()
+            t_next = t_begin
+            deadline = t_begin + duration_s
+            while t_next < deadline:
+                now = time.perf_counter()
+                if now < t_next:
+                    time.sleep(t_next - now)
+                nrows = arr_rng.choice(row_mix)
+                t = threading.Thread(target=one,
+                                     args=(bodies[nrows], nrows))
+                t.start()
+                threads.append(t)
+                offered[0] += 1
+                t_next += arr_rng.expovariate(rate_rps)
+            for t in threads:
+                t.join(timeout=35.0)
+            dt = time.perf_counter() - t_begin
+
+            def pct(q):
+                vals = sorted(lat)
+                if not vals:
+                    return float("inf")
+                return vals[min(len(vals) - 1,
+                                int(q * (len(vals) - 1)))] * 1e3
+
+            return rows_done[0] / dt, pct(0.99), errors[0], offered[0]
+
+        def start_fleet(n, extra=()):
+            proc = subprocess.Popen(
+                [sys.executable, "-m", "deeplearning4j_tpu.cli", "serve",
+                 "--model", ckpt, "--compile-cache", cache,
+                 "--shapes", shapes, "--replicas", str(n),
+                 "--max-delay-ms", "2", "--drain-timeout", "10",
+                 *extra],
+                stdout=subprocess.PIPE, text=True, env=env)
+            return proc, json_mod.loads(proc.stdout.readline())
+
+        def stop_fleet(proc):
+            proc.send_signal(signal.SIGTERM)
+            try:
+                proc.communicate(timeout=60)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                proc.communicate()
+
+        # -- arm 1: the rate ladder, 1 vs 2 replicas ------------------------
+        sustained = {}
+        for n_replicas in (1, 2):
+            proc, summary = start_fleet(n_replicas)
+            best = {"rows_s": 0.0, "rate": 0.0, "p99_ms": None}
+            try:
+                for rate in rates:
+                    rows_s, p99_ms, errors, offered = open_loop(
+                        summary["url"], rate, level_s, seed=int(rate))
+                    if p99_ms <= slo_p99_ms and errors == 0:
+                        best = {"rows_s": rows_s, "rate": rate,
+                                "p99_ms": round(p99_ms, 2)}
+                    else:
+                        break  # the ladder found the knee; stop offering
+            finally:
+                stop_fleet(proc)
+            sustained[n_replicas] = best
+        _emit("fleet SLO-sustained rows/sec (2 replicas)",
+              sustained[2]["rows_s"], "rows/sec",
+              sustained[2]["rows_s"] / max(sustained[1]["rows_s"], 1e-9),
+              slo_p99_ms=slo_p99_ms,
+              sustained_1replica=sustained[1],
+              sustained_2replica=sustained[2],
+              open_loop="poisson", row_mix=list(row_mix),
+              baseline_note="vs_baseline = 2-replica / 1-replica max "
+                            "open-loop rows/s with p99 under the SLO and "
+                            "zero errors, same Poisson generator")
+
+        # -- arm 2: kill-and-heal timeline ----------------------------------
+        proc, summary = start_fleet(
+            2, extra=("--min-replicas", "2", "--max-replicas", "2"))
+        try:
+            url = summary["url"]
+            victim_pid = summary["replica_pids"][0]
+            result = {}
+
+            def load_then_report():
+                result["load"] = open_loop(url, heal_rate, heal_s, seed=7)
+
+            loader = threading.Thread(target=load_then_report)
+            loader.start()
+            time.sleep(heal_s * 0.25)  # mid-window, load in flight
+            t_kill = time.perf_counter()
+            os.kill(victim_pid, signal.SIGKILL)
+            healed_at = None
+            fresh_after = None
+            while time.perf_counter() - t_kill < heal_wait_s:
+                try:
+                    with urllib.request.urlopen(url + "/v1/stats",
+                                                timeout=5) as r:
+                        st = json_mod.loads(r.read())
+                except Exception:
+                    time.sleep(0.2)
+                    continue
+                fleet = st.get("fleet", {})
+                if (st.get("healthy_replicas", 0) >= 2
+                        and fleet.get("restarts_total", 0) >= 1):
+                    healed_at = time.perf_counter() - t_kill
+                    fresh_after = [s.get("fresh_compiles")
+                                   for s in fleet.get("slots", [])]
+                    break
+                time.sleep(0.2)
+            loader.join()
+            rows_s, p99_ms, errors, offered = result["load"]
+            _emit("fleet kill-and-heal time", healed_at or heal_wait_s,
+                  "sec", None,
+                  healed=healed_at is not None,
+                  errors_during_heal=errors,
+                  offered_requests=offered,
+                  rows_per_sec_during=round(rows_s, 1),
+                  p99_ms_during=round(p99_ms, 2),
+                  fresh_compiles_after_heal=fresh_after,
+                  baseline_note="SIGKILL one of 2 replicas under open-loop "
+                                "load; time until the supervisor restored "
+                                "a 2-healthy fleet (fresh_compiles 0 = "
+                                "warm-cache respawn)")
+        finally:
+            stop_fleet(proc)
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+# ---------------------------------------------------------------------------
 # prefetch — LeNet mini-batch fit with the async device_put pipeline on/off
 # ---------------------------------------------------------------------------
 
@@ -1456,6 +1666,7 @@ BENCHES = [bench_lenet, bench_char_lstm, bench_vgg_cifar10, bench_word2vec,
            bench_elastic_resume,
            bench_char_lstm4, bench_step_cache, bench_infer_latency,
            bench_serve, bench_serve_precision, bench_serve_router,
+           bench_fleet_slo,
            bench_prefetch,
            bench_cold_start, bench_north_star_cli,
            bench_attention_fused_bwd, bench_attention_crossover,

@@ -586,12 +586,28 @@ class ReplicaProcess:
     def url(self) -> Optional[str]:
         return None if self.summary is None else self.summary.get("url")
 
+    @property
+    def pid(self) -> int:
+        return self.proc.pid
+
+    def poll(self) -> Optional[int]:
+        """Exit code if the process died, None while alive — the
+        supervisor's reap probe."""
+        return self.proc.poll()
+
     def terminate(self) -> None:
         import signal
 
         if self.proc.poll() is None:
             try:
                 self.proc.send_signal(signal.SIGTERM)
+            except OSError:
+                pass
+
+    def kill(self) -> None:
+        if self.proc.poll() is None:
+            try:
+                self.proc.kill()
             except OSError:
                 pass
 
@@ -606,26 +622,52 @@ class ReplicaProcess:
 
 def cmd_serve_router(args) -> int:
     """serve --replicas N: spawn N replica subprocesses sharing the
-    --compile-cache dir, front them with `serving.Router`, mirror the
+    --compile-cache dir, front them with `serving.Router`, supervise
+    them (`FleetSupervisor` reaps + respawns deaths, `Autoscaler` flexes
+    the fleet between --min/--max-replicas), and mirror the
     single-server SIGTERM contract fleet-wide — drain the ROUTER first
     (every accepted request still finds its replica), then SIGTERM the
     replicas and insist they all drain to exit 0."""
     import signal
 
+    from deeplearning4j_tpu.serving.autoscaler import Autoscaler
     from deeplearning4j_tpu.serving.router import Router
+    from deeplearning4j_tpu.serving.supervisor import FleetSupervisor
 
+    min_replicas = getattr(args, "min_replicas", None) or args.replicas
+    max_replicas = getattr(args, "max_replicas", None) or args.replicas
     cmd = _replica_cmd(args)
     replicas = [ReplicaProcess(cmd) for _ in range(args.replicas)]
-    router = None
+    router = supervisor = autoscaler = None
     try:
         summaries = [r.wait_ready() for r in replicas]
         router = Router([s["url"] for s in summaries],
                         host=args.host, port=args.port,
                         request_timeout_s=getattr(args, "request_timeout",
-                                                  30.0) + 5.0).start()
+                                                  30.0) + 5.0,
+                        hedge=getattr(args, "hedge", False),
+                        retry_budget_ratio=getattr(args, "retry_budget",
+                                                   0.1)).start()
+        # the supervisor adopts the already-ready initial handles; a
+        # respawn re-runs the same replica command line against the same
+        # shared disk cache, so coming back is seconds, not compiles
+        supervisor = FleetSupervisor(
+            spawn_fn=lambda: ReplicaProcess(cmd), router=router,
+            initial=replicas, min_replicas=min_replicas,
+            max_replicas=max_replicas,
+            drain_timeout_s=getattr(args, "drain_timeout", 10.0)).start()
+        if max_replicas > min_replicas:
+            autoscaler = Autoscaler(
+                router, supervisor,
+                slo_p99_ms=getattr(args, "slo_p99_ms", 500.0)).start()
+        router.attach_fleet(supervisor, autoscaler)
         print(json.dumps({
             "url": router.url,
             "replicas": [s["url"] for s in summaries],
+            "replica_pids": [r.pid for r in replicas],
+            "min_replicas": min_replicas,
+            "max_replicas": max_replicas,
+            "hedge": router.hedge,
             "fresh_compiles": [s.get("fresh_compiles") for s in summaries],
             "mesh_devices": summaries[0].get("mesh_devices"),
         }), flush=True)
@@ -645,22 +687,34 @@ def cmd_serve_router(args) -> int:
                 signal.signal(sig, handler)
     finally:
         drain_timeout = getattr(args, "drain_timeout", 10.0)
+        # shutdown order: control plane first (no respawn or scale
+        # action races the teardown), then the router drain (accepted
+        # requests finish against live replicas), then SIGTERM whatever
+        # processes the supervisor currently owns
+        if autoscaler is not None:
+            autoscaler.stop()
+        if supervisor is not None:
+            supervisor.stop()
         if router is not None:
             router.drain(drain_timeout)
-        for r in replicas:
+        handles = supervisor.handles() if supervisor is not None else replicas
+        for r in handles:
             r.terminate()
         rcs = []
-        for r in replicas:
+        for r in handles:
             try:
                 rcs.append(r.wait(timeout=drain_timeout + 15.0))
             except Exception:  # noqa: BLE001 — a wedged replica: kill
-                r.proc.kill()
+                r.kill()
                 rcs.append(r.wait())
         stats = router.stats() if router is not None else {}
+        fleet = stats.get("fleet", {})
         print(json.dumps({"drained": True,
                           "replica_exit_codes": rcs,
                           "retries": stats.get("retries", 0),
-                          "unroutable": stats.get("unroutable", 0)}),
+                          "unroutable": stats.get("unroutable", 0),
+                          "hedges": stats.get("hedges", 0),
+                          "restarts": fleet.get("restarts_total", 0)}),
               flush=True)
     return 0 if rcs and all(rc == 0 for rc in rcs) else 1
 
@@ -809,6 +863,31 @@ def build_parser() -> argparse.ArgumentParser:
                         "gateway, all sharing --compile-cache) with the "
                         "routing front end; 0 (default) serves in-process "
                         "with no router")
+    s.add_argument("--min-replicas", dest="min_replicas", type=int,
+                   default=None, metavar="N",
+                   help="floor for the supervised fleet (default: "
+                        "--replicas); scale-down and quarantine never "
+                        "shrink below it")
+    s.add_argument("--max-replicas", dest="max_replicas", type=int,
+                   default=None, metavar="N",
+                   help="ceiling for the supervised fleet (default: "
+                        "--replicas); setting it above --min-replicas "
+                        "enables the autoscaler")
+    s.add_argument("--hedge", action="store_true",
+                   help="hedged requests: a proxy attempt that outlives "
+                        "the p95 of recent latencies is duplicated at a "
+                        "second replica, first answer wins; hedges and "
+                        "retries share the --retry-budget")
+    s.add_argument("--retry-budget", dest="retry_budget", type=float,
+                   default=0.1, metavar="RATIO",
+                   help="extra attempts (retries + hedges) allowed as a "
+                        "fraction of the trailing request window "
+                        "(default 0.1); exhausted requests degrade to "
+                        "single-attempt instead of storming")
+    s.add_argument("--slo-p99-ms", dest="slo_p99_ms", type=float,
+                   default=500.0,
+                   help="autoscaler latency objective: fleet p99 above "
+                        "this is a scale-up signal")
     s.add_argument("--mesh", action="store_true",
                    help="shard each coalesced batch's rows across every "
                         "visible device (Mesh(('batch',)), params "

@@ -3,6 +3,7 @@ breaking, and the exception vocabulary shared by the hardened serving
 and training paths.  Stdlib-only — importable before (and without) jax.
 """
 
+from deeplearning4j_tpu.reliability.budget import RetryBudget
 from deeplearning4j_tpu.reliability.circuit import CircuitBreaker
 from deeplearning4j_tpu.reliability.faults import (
     FaultInjected,
@@ -36,6 +37,7 @@ __all__ = [
     "FaultPlanError",
     "FaultRegistry",
     "REGISTRY",
+    "RetryBudget",
     "TrainingInterrupted",
     "arm",
     "disarm",

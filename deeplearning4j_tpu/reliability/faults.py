@@ -26,6 +26,22 @@ Injection points wired into the codebase:
                           (parallel/data_parallel.py) — an armed raise
                           "kills" mesh training mid-epoch for the
                           elastic-resume chaos tests
+  ``router.proxy``        per proxy attempt in `Router.route_predict`
+                          (serving/router.py): ``raise`` fails the
+                          attempt (breaker failure + fail-over),
+                          ``delay`` slows it — that's what makes the
+                          primary outlive the hedge delay in the
+                          hedging and retry-budget tests
+  ``router.poll``         per replica health poll (`Replica.poll`):
+                          ``raise`` counts as an unready answer,
+                          ``delay`` wedges one poll to prove the
+                          concurrent poll loop still ejects siblings
+                          on time
+  ``supervisor.spawn``    per replica (re)spawn attempt in
+                          `FleetSupervisor` (serving/supervisor.py) —
+                          an armed raise makes the respawn fail, which
+                          is what drives the crash-loop quarantine
+                          tests
 
 The registry is generic — tests may `fire()` arbitrary point names of
 their own.  With nothing armed, `fire()` is a counter bump under a lock:

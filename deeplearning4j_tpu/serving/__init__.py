@@ -5,15 +5,21 @@ one bucketed device call over the serve-path AOT compile cache
 (`optimize/infer_cache.py`): `MicroBatcher` coalesces (with priority
 classes — interactive preempts batch), `ModelServer` exposes one
 replica over HTTP, `Router` spreads `/v1/predict` across N replica
-processes sharing one warmed disk compile cache, and
-`serving.metrics` exports the whole fleet's counters in Prometheus
-text format at `/metrics`.  Hardened by the resilience layer
-(ISSUE 5): per-request deadlines, circuit breakers with eager degraded
-mode, health/readiness endpoints, and bounded graceful drain —
-router-first, then replicas.
+processes sharing one warmed disk compile cache (with hedged requests
+under a shared `RetryBudget`), and `serving.metrics` exports the whole
+fleet's counters in Prometheus text format at `/metrics`.  The control
+plane makes the fleet self-healing: `FleetSupervisor` reaps and
+respawns dead replicas (backoff + crash-loop quarantine) and
+`Autoscaler` grows/shrinks the fleet from the signals the router
+already polls.  Hardened by the resilience layer (ISSUE 5):
+per-request deadlines, circuit breakers with eager degraded mode,
+health/readiness endpoints, and bounded graceful drain — router-first,
+then replicas.
 """
 
-from deeplearning4j_tpu.reliability import CircuitBreaker, DeadlineExceeded
+from deeplearning4j_tpu.reliability import (CircuitBreaker, DeadlineExceeded,
+                                            RetryBudget)
+from deeplearning4j_tpu.serving.autoscaler import Autoscaler
 from deeplearning4j_tpu.serving.batcher import (LATENCY_BUCKETS_S,
                                                 PRIORITIES, MicroBatcher,
                                                 ServerOverloaded)
@@ -23,8 +29,10 @@ from deeplearning4j_tpu.serving.metrics import (CONTENT_TYPE,
                                                 router_metrics)
 from deeplearning4j_tpu.serving.router import Replica, Router
 from deeplearning4j_tpu.serving.server import ModelServer, ServerDraining
+from deeplearning4j_tpu.serving.supervisor import FleetSupervisor
 
-__all__ = ["CONTENT_TYPE", "CircuitBreaker", "DeadlineExceeded",
-           "LATENCY_BUCKETS_S", "MicroBatcher", "ModelServer", "PRIORITIES",
-           "Replica", "Router", "ServerDraining", "ServerOverloaded",
+__all__ = ["Autoscaler", "CONTENT_TYPE", "CircuitBreaker",
+           "DeadlineExceeded", "FleetSupervisor", "LATENCY_BUCKETS_S",
+           "MicroBatcher", "ModelServer", "PRIORITIES", "Replica",
+           "RetryBudget", "Router", "ServerDraining", "ServerOverloaded",
            "parse_prometheus_text", "replica_metrics", "router_metrics"]

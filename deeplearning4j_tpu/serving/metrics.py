@@ -255,6 +255,20 @@ def router_metrics(stats: dict) -> str:
     p.counter("dl4j_router_unroutable_total",
               "Requests answered 503: no routable replica.",
               stats.get("unroutable", 0))
+    p.counter("dl4j_router_hedges_total",
+              "Hedged duplicate attempts fired after the quantile-"
+              "tracked delay.", stats.get("hedges", 0))
+    p.counter("dl4j_router_hedge_wins_total",
+              "Hedged attempts that answered before the primary.",
+              stats.get("hedge_wins", 0))
+    budget = stats.get("retry_budget", {})
+    p.gauge("dl4j_router_retry_budget_remaining",
+            "Retry/hedge tokens left in the trailing budget window.",
+            budget.get("remaining", 0))
+    p.counter("dl4j_router_retry_budget_exhausted_total",
+              "Extra attempts denied by the retry budget (the request "
+              "degraded to single-attempt).",
+              budget.get("exhausted_total", 0))
     for pol, rows in sorted(stats.get("rows_by_policy", {}).items()):
         p.counter("dl4j_router_policy_rows_total",
                   "Fleet-wide feature rows served per precision policy, "
@@ -270,9 +284,37 @@ def router_metrics(stats: dict) -> str:
                 "2 half-open.",
                 CircuitBreaker.STATE_CODES.get(
                     rep.get("breaker", {}).get("state"), 0), rl)
+        age = rep.get("last_ok_poll_age_s")
+        if age is not None:
+            p.gauge("dl4j_router_replica_stats_age_seconds",
+                    "Seconds since the replica's stats were last polled "
+                    "successfully.", age, rl)
         rep_stats = rep.get("stats")
-        if rep_stats:
+        # a stale replica's cached stats are history, not state: keep
+        # them off the page rather than exporting a dead replica as live
+        if rep_stats and not rep.get("stale"):
             replica_metrics(rep_stats, page=p, labels=rl)
+    fleet = stats.get("fleet")
+    if fleet:
+        for state, n in sorted(fleet.get("states", {}).items()):
+            p.gauge("dl4j_fleet_replicas",
+                    "Supervised replica slots by lifecycle state.",
+                    n, {"state": state})
+        p.counter("dl4j_fleet_restarts_total",
+                  "Replica processes respawned after a death.",
+                  fleet.get("restarts_total", 0))
+        p.counter("dl4j_fleet_spawn_failures_total",
+                  "Respawn attempts that failed before the replica "
+                  "became ready.", fleet.get("spawn_failures_total", 0))
+    autoscaler = stats.get("autoscaler")
+    if autoscaler:
+        for decision, n in sorted(autoscaler.get("decisions", {}).items()):
+            p.counter("dl4j_autoscaler_decisions_total",
+                      "Autoscaler evaluations by decision.",
+                      n, {"decision": decision})
+        p.gauge("dl4j_autoscaler_target_replicas",
+                "Replica count the autoscaler currently wants.",
+                autoscaler.get("target_replicas", 0))
     return p.render()
 
 

@@ -1,0 +1,345 @@
+"""Fleet supervision: replicas that die come back, ones that crash-loop
+don't get to take the fleet with them.
+
+The router (serving/router.py) routes AROUND a dead replica; this module
+is the control-plane half the datacenter shape requires: someone has to
+notice the corpse, reap it, and put a fresh replica in rotation —
+without an operator, and without a hot respawn loop when the crash is
+deterministic.  `FleetSupervisor` owns the replica process set behind
+`serve --replicas N`:
+
+  reap      a watcher thread polls every handle's `poll()` (the
+            `Popen.returncode` probe); a death is logged with its exit
+            code and the replica's URL leaves the router rotation
+            immediately, so the fleet stops burning fail-over retries
+            on a corpse.
+  respawn   each death schedules a respawn after full-jitter exponential
+            backoff — the same `backoff_seconds` shape the dataset
+            fetcher uses (attempt k waits U(0,1) * min(8s, 0.5 * 2^k)).
+            A respawned replica warms from the SHARED disk compile
+            cache, so coming back is seconds of process startup, not
+            minutes of XLA compiles (`fresh_compiles == 0` is asserted
+            in the chaos tests).  The new process lands on a new
+            ephemeral port; its URL is re-registered with the router's
+            mutable replica set.
+  quarantine a replica that dies `max_restarts` times inside
+            `restart_window_s` is CRASH-LOOPING — respawning it faster
+            only turns a deterministic bug into a fork bomb.  The slot
+            is quarantined for `quarantine_s`, after which ONE probe
+            respawn is allowed (the window has drained, so a further
+            death re-quarantines after the remaining budget).
+  scale     `scale_up()` / `scale_down()` are the autoscaler's verbs.
+            Up spawns into the first free slot (bounded by
+            `max_replicas`).  Down picks the EMPTIEST running replica
+            (lowest last-polled queue depth), pulls it from rotation
+            FIRST, then SIGTERMs it — the replica's own graceful drain
+            answers everything it had accepted, so scale-down provably
+            drops zero requests.
+
+Lock ordering: the supervisor calls `router.add_replica`/
+`remove_replica` (which take the router's `_state_lock`) only OUTSIDE
+its own `_lock`, and the router calls `supervisor.stats()` without
+holding its state lock — no lock cycle exists.
+
+Fault-injection: every (re)spawn traverses the ``supervisor.spawn``
+point (reliability/faults.py); arming it is how the quarantine tests
+make respawns fail deterministically.
+
+`spawn_fn` is any zero-arg callable returning a process handle with the
+`ReplicaProcess` surface (`wait_ready()`, `url`, `poll()`,
+`terminate()`, `wait()`, `kill()`); tests substitute in-process fakes
+wrapping real `ModelServer`s.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Callable, Dict, List, Optional
+
+from deeplearning4j_tpu.datasets.fetch import backoff_seconds
+from deeplearning4j_tpu.reliability import faults
+
+#: slot lifecycle states (exported as dl4j_fleet_replicas{state=...};
+#: every state is always exported, zeros included, so dashboards see a
+#: stable label set)
+STATES = ("running", "backoff", "quarantined", "stopped")
+
+
+class _Slot:
+    """One supervised replica position: the process handle currently
+    filling it plus the death/backoff/quarantine bookkeeping."""
+
+    def __init__(self, slot_id: int):
+        self.id = slot_id
+        self.handle = None
+        self.url: Optional[str] = None
+        self.state = "stopped"
+        self.deaths: deque = deque()     # timestamps inside the window
+        self.attempt = 0                 # consecutive failed comebacks
+        self.restarts = 0
+        self.last_exit: Optional[int] = None
+        self.next_spawn_at: Optional[float] = None
+        self.quarantined_at: Optional[float] = None
+        self.summary: Optional[dict] = None
+
+    def describe(self, now: float) -> dict:
+        return {
+            "id": self.id,
+            "url": self.url,
+            "state": self.state,
+            "restarts": self.restarts,
+            "deaths_in_window": len(self.deaths),
+            "last_exit": self.last_exit,
+            # the respawn warms from the shared disk cache: this staying
+            # 0 across restarts is the "seconds, not compiles" proof
+            "fresh_compiles": (self.summary or {}).get("fresh_compiles"),
+            "backoff_remaining_s": (
+                None if self.next_spawn_at is None
+                else round(max(self.next_spawn_at - now, 0.0), 3)),
+        }
+
+
+class FleetSupervisor:
+    """Owns the replica process set: reap, respawn with backoff,
+    quarantine crash-loops, scale between min and max replicas.
+
+    spawn_fn:         () -> handle; must block-start the process (the
+                      supervisor calls `wait_ready()` itself).
+    router:           the mutable-replica-set `Router` to (de)register
+                      URLs with.
+    initial:          already-ready handles adopted at construction
+                      (the CLI spawns the initial fleet before the
+                      router exists, then hands the handles over).
+    max_restarts / restart_window_s: the crash-loop breaker — that many
+                      deaths inside the window quarantines the slot.
+    quarantine_s:     how long a quarantined slot sits out before one
+                      probe respawn.
+    backoff_fn:       (attempt) -> seconds; injectable so tests collapse
+                      the jittered waits.
+    clock:            injectable monotonic clock for deterministic tests.
+    """
+
+    def __init__(self, spawn_fn: Callable[[], object], router,
+                 initial=(), min_replicas: int = 1, max_replicas: int = 1,
+                 poll_interval_s: float = 0.25,
+                 max_restarts: int = 5, restart_window_s: float = 30.0,
+                 quarantine_s: float = 60.0,
+                 drain_timeout_s: float = 10.0,
+                 backoff_fn: Callable[[int], float] = backoff_seconds,
+                 clock=time.monotonic):
+        if max_replicas < min_replicas:
+            raise ValueError("max_replicas must be >= min_replicas")
+        self.spawn_fn = spawn_fn
+        self.router = router
+        self.min_replicas = int(min_replicas)
+        self.max_replicas = int(max_replicas)
+        self.poll_interval_s = float(poll_interval_s)
+        self.max_restarts = int(max_restarts)
+        self.restart_window_s = float(restart_window_s)
+        self.quarantine_s = float(quarantine_s)
+        self.drain_timeout_s = float(drain_timeout_s)
+        self.backoff_fn = backoff_fn
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._slots: List[_Slot] = []
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._restarts_total = 0
+        self._spawn_failures_total = 0
+        self._quarantines_total = 0
+        for handle in initial:
+            slot = _Slot(len(self._slots))
+            slot.handle = handle
+            slot.url = handle.url
+            slot.summary = getattr(handle, "summary", None)
+            slot.state = "running"
+            self._slots.append(slot)
+
+    # -- spawning ------------------------------------------------------------
+    def _spawn_into(self, slot: _Slot) -> bool:
+        """(Re)fill `slot` with a fresh process and put its URL in
+        rotation.  Called WITHOUT `_lock` held (spawning blocks on
+        warmup; router registration takes the router's lock).  Returns
+        False — and books the death — when the spawn itself fails."""
+        try:
+            faults.fire("supervisor.spawn", slot=slot.id)
+            handle = self.spawn_fn()
+            summary = handle.wait_ready()
+        except BaseException as e:  # noqa: BLE001 — incl. SystemExit from
+            # wait_ready on a child that died during startup: a spawn
+            # failure is a death, never a supervisor crash
+            now = self._clock()
+            with self._lock:
+                self._spawn_failures_total += 1
+                slot.attempt += 1
+                slot.deaths.append(now)
+                slot.last_exit = None
+                self._schedule_locked(slot, now, reason=str(e))
+            return False
+        url = handle.url
+        with self._lock:
+            slot.handle = handle
+            slot.url = url
+            slot.summary = summary
+            slot.state = "running"
+            slot.next_spawn_at = None
+            slot.quarantined_at = None
+            slot.attempt = 0
+        self.router.add_replica(url)
+        return True
+
+    def _schedule_locked(self, slot: _Slot, now: float,
+                         reason: str = "") -> None:
+        """Decide what happens to a slot that just lost its process:
+        backoff-respawn, or quarantine when it is crash-looping.
+        Caller holds `_lock`."""
+        horizon = now - self.restart_window_s
+        while slot.deaths and slot.deaths[0] <= horizon:
+            slot.deaths.popleft()
+        if len(slot.deaths) >= self.max_restarts:
+            slot.state = "quarantined"
+            slot.quarantined_at = now
+            slot.next_spawn_at = now + self.quarantine_s
+            self._quarantines_total += 1
+        else:
+            slot.state = "backoff"
+            slot.next_spawn_at = now + self.backoff_fn(
+                max(slot.attempt, 1))
+
+    # -- the supervision loop -------------------------------------------------
+    def tick(self) -> None:
+        """One supervision pass: reap deaths, start due respawns.
+        Public so tests drive it deterministically; the background
+        thread just calls it on `poll_interval_s`."""
+        now = self._clock()
+        dead: List[_Slot] = []
+        due: List[_Slot] = []
+        with self._lock:
+            for slot in self._slots:
+                if slot.state == "running":
+                    rc = slot.handle.poll() if slot.handle is not None else 0
+                    if rc is not None:
+                        slot.last_exit = rc
+                        slot.attempt += 1
+                        slot.deaths.append(now)
+                        self._schedule_locked(slot, now)
+                        dead.append(slot)
+                elif slot.state in ("backoff", "quarantined"):
+                    if (slot.next_spawn_at is not None
+                            and now >= slot.next_spawn_at):
+                        due.append(slot)
+        # router mutation + respawns happen OUTSIDE _lock (lock
+        # ordering; spawns block on replica warmup)
+        for slot in dead:
+            if slot.url:
+                self.router.remove_replica(slot.url)
+        for slot in due:
+            if self._spawn_into(slot):
+                with self._lock:
+                    slot.restarts += 1
+                    self._restarts_total += 1
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.poll_interval_s):
+            self.tick()
+
+    # -- scaling (the autoscaler's verbs) -------------------------------------
+    def running_count(self) -> int:
+        with self._lock:
+            return sum(1 for s in self._slots if s.state == "running")
+
+    def scale_up(self) -> bool:
+        """Add one replica (bounded by `max_replicas`); blocks on its
+        warmup — which is seconds, not compiles, because it reads the
+        shared warmed disk cache.  Returns True when a replica joined
+        the rotation."""
+        with self._lock:
+            live = sum(1 for s in self._slots
+                       if s.state in ("running", "backoff"))
+            if live >= self.max_replicas:
+                return False
+            slot = next((s for s in self._slots if s.state == "stopped"),
+                        None)
+            if slot is None:
+                slot = _Slot(len(self._slots))
+                self._slots.append(slot)
+            slot.state = "backoff"  # claimed: a concurrent tick skips it
+            slot.next_spawn_at = None
+        return self._spawn_into(slot)
+
+    def scale_down(self) -> bool:
+        """Remove one replica without dropping a single request: pick
+        the emptiest RUNNING replica (lowest last-polled queue depth),
+        pull it from rotation FIRST, then SIGTERM — its own graceful
+        drain answers everything already accepted.  Refuses below
+        `min_replicas`."""
+        with self._lock:
+            running = [s for s in self._slots if s.state == "running"]
+            if len(running) <= self.min_replicas:
+                return False
+
+            def queue_depth(slot: _Slot) -> int:
+                rep = self.router.find_replica(slot.url or "")
+                st = rep.last_stats if rep is not None else None
+                if not st:
+                    return 0
+                return sum(p.get("queue_depth", 0)
+                           for p in st.get("priorities", {}).values())
+
+            victim = min(running, key=queue_depth)
+            victim.state = "draining"  # off-limits to tick() reaping
+        self.router.remove_replica(victim.url)
+        handle = victim.handle
+        rc: Optional[int] = None
+        if handle is not None:
+            handle.terminate()
+            try:
+                rc = handle.wait(timeout=self.drain_timeout_s + 15.0)
+            except Exception:  # noqa: BLE001 — wedged: escalate
+                handle.kill()
+                rc = handle.wait()
+        with self._lock:
+            victim.state = "stopped"
+            victim.handle = None
+            victim.last_exit = rc
+        return True
+
+    # -- lifecycle ------------------------------------------------------------
+    def start(self) -> "FleetSupervisor":
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="dl4j-fleet-supervisor")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        """Stop supervising (no more reaps/respawns).  Does NOT touch
+        the replica processes — the CLI drains the router first and
+        then terminates the handles this supervisor reports."""
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=self.poll_interval_s * 4 + 1.0)
+
+    def handles(self) -> List[object]:
+        """Live process handles for the CLI's final SIGTERM sweep."""
+        with self._lock:
+            return [s.handle for s in self._slots if s.handle is not None]
+
+    # -- observability --------------------------------------------------------
+    def stats(self) -> dict:
+        now = self._clock()
+        with self._lock:
+            states = {s: 0 for s in STATES}
+            states["draining"] = 0
+            for slot in self._slots:
+                states[slot.state] = states.get(slot.state, 0) + 1
+            return {
+                "min_replicas": self.min_replicas,
+                "max_replicas": self.max_replicas,
+                "states": states,
+                "restarts_total": self._restarts_total,
+                "spawn_failures_total": self._spawn_failures_total,
+                "quarantines_total": self._quarantines_total,
+                "slots": [s.describe(now) for s in self._slots],
+            }

@@ -1,0 +1,747 @@
+"""Self-healing fleet chaos suite (ISSUE 11): retry budget, hedged
+requests, mutable router rotation, staleness, concurrent polling,
+supervision (respawn/backoff/quarantine), autoscaling, the new
+Prometheus families, and the CLI kill-and-heal acceptance smoke —
+SIGKILL one of 2 replicas under load, every request gets a correct
+answer or a clean 5xx, the supervisor restores the fleet from the warm
+disk cache (fresh_compiles == 0), counters reconcile with what the
+clients saw, SIGTERM drain exits 0.
+
+Tier-1: CPU-only; in-process pieces are driven deterministically
+(parked pollers, `tick()`/`evaluate_once()` by hand, injectable clocks
+and backoff), the subprocess smoke uses short timeouts + a watchdog."""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.models.zoo import mlp
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.parallel import checkpoint
+from deeplearning4j_tpu.reliability import RetryBudget, faults
+from deeplearning4j_tpu.serving import (Autoscaler, FleetSupervisor, Router,
+                                        parse_prometheus_text,
+                                        router_metrics)
+
+N_IN, N_OUT = 6, 3
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.reset()
+    yield
+    faults.reset()
+
+
+def _net(seed=0):
+    net = MultiLayerNetwork(mlp(n_in=N_IN, hidden=[8], n_out=N_OUT,
+                                lr=0.05), seed=seed).init()
+    net.warmup([1, 2, 4])
+    return net
+
+
+def _x(rows, seed=0):
+    rng = np.random.RandomState(seed)
+    return rng.randn(rows, N_IN).astype(np.float32)
+
+
+def _http(url, body=None, timeout=30):
+    req = urllib.request.Request(
+        url, data=None if body is None else json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, resp.read().decode()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode()
+
+
+def _start_fleet(n=2, poll_interval_s=3600.0, **router_kw):
+    """N warmed in-process replicas behind a router whose background
+    poller is parked (huge interval): health transitions are driven by
+    poll_once(), deterministically."""
+    servers = [_net(seed=0).serve(max_delay_ms=1.0) for _ in range(n)]
+    router = Router([s.url for s in servers],
+                    poll_interval_s=poll_interval_s, **router_kw).start()
+    return servers, router
+
+
+def _stop_all(router, servers):
+    router.stop()
+    for s in servers:
+        s.stop()
+
+
+class _FakeClock:
+    def __init__(self, t=100.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+class _Handle:
+    """In-process stand-in for `ReplicaProcess`: a real `ModelServer`
+    with a settable exit code, so supervisor tests reap/respawn without
+    subprocess spawn cost."""
+
+    def __init__(self):
+        self.server = _net(seed=0).serve(max_delay_ms=1.0)
+        self._rc = None
+        self.summary = {"url": self.server.url, "fresh_compiles": 0}
+
+    @property
+    def url(self):
+        return self.server.url
+
+    def wait_ready(self):
+        return self.summary
+
+    def poll(self):
+        return self._rc
+
+    def die(self, rc=-9):
+        """SIGKILL equivalent: the server vanishes, the exit code shows
+        up at the next supervisor poll."""
+        self.server.stop()
+        self._rc = rc
+
+    def terminate(self):
+        self.server.stop()  # ModelServer.stop == graceful drain
+        self._rc = 0
+
+    def kill(self):
+        self.die(-9)
+
+    def wait(self, timeout=None):
+        return self._rc if self._rc is not None else 0
+
+
+# -- retry budget ------------------------------------------------------------
+
+def test_retry_budget_min_tokens_and_window():
+    clk = _FakeClock()
+    b = RetryBudget(ratio=0.1, min_tokens=2, window_s=10.0, clock=clk)
+    # no traffic at all: the floor still allows min_tokens spends
+    assert b.try_spend() and b.try_spend()
+    assert not b.try_spend()
+    assert b.stats()["exhausted_total"] == 1
+    # the window slides: old spends age out and tokens come back
+    clk.t += 11.0
+    assert b.remaining() == 2.0
+    assert b.try_spend()
+
+
+def test_retry_budget_ratio_scales_with_traffic():
+    clk = _FakeClock()
+    b = RetryBudget(ratio=0.1, min_tokens=1, window_s=10.0, clock=clk)
+    for _ in range(100):
+        b.note_request()
+    # 10% of 100 requests = 10 tokens
+    assert b.remaining() == 10.0
+    for _ in range(10):
+        assert b.try_spend()
+    assert not b.try_spend()
+    st = b.stats()
+    assert st["requests_in_window"] == 100
+    assert st["spent_in_window"] == 10
+    assert st["remaining"] == 0.0
+
+
+# -- mutable rotation --------------------------------------------------------
+
+def test_router_add_remove_replica_rotation_safe():
+    servers, router = _start_fleet(n=1)
+    extra = _net(seed=0).serve(max_delay_ms=1.0)
+    try:
+        assert router.healthy_count() == 1
+        rep = router.add_replica(extra.url)
+        assert rep.ready and router.healthy_count() == 2
+        for i in range(4):
+            code, _ = _http(router.url + "/v1/predict",
+                            {"features": _x(1, seed=i).tolist()})
+            assert code == 200
+        router.poll_once()
+        per = [r["stats"]["requests"] if r["stats"] else 0
+               for r in router.stats()["replicas"]]
+        assert all(n >= 1 for n in per), per  # both replicas served
+        # removal is by URL and immediate; traffic keeps flowing
+        assert router.remove_replica(servers[0].url) is not None
+        assert len(router.replicas) == 1
+        for i in range(2):
+            code, _ = _http(router.url + "/v1/predict",
+                            {"features": _x(1, seed=i).tolist()})
+            assert code == 200
+        assert router.remove_replica("http://127.0.0.1:1/none") is None
+    finally:
+        _stop_all(router, servers)
+        extra.stop()
+
+
+# -- hedging + budget --------------------------------------------------------
+
+def test_hedge_fires_on_slow_replica_and_wins():
+    servers, router = _start_fleet(n=2, hedge=True, hedge_floor_ms=20.0,
+                                   hedge_ceil_ms=120.0)
+    try:
+        # first proxy attempt (the primary) stalls well past the hedge
+        # delay; the hedge lands on the sibling and answers first
+        faults.arm("router.proxy", "delay", delay_s=1.0)
+        t0 = time.monotonic()
+        code, body = _http(router.url + "/v1/predict",
+                           {"features": _x(1).tolist()})
+        elapsed = time.monotonic() - t0
+        assert code == 200, body
+        assert elapsed < 0.9, elapsed  # did NOT wait out the slow primary
+        st = router.stats()
+        assert st["hedges"] == 1
+        assert st["hedge_wins"] == 1
+        assert st["retry_budget"]["spent_total"] == 1  # the hedge paid
+    finally:
+        _stop_all(router, servers)
+
+
+def test_hedge_respects_exhausted_budget():
+    servers, router = _start_fleet(n=2, hedge=True, hedge_floor_ms=20.0,
+                                   hedge_ceil_ms=60.0,
+                                   retry_budget_ratio=0.0,
+                                   retry_budget_min=0)
+    try:
+        faults.arm("router.proxy", "delay", delay_s=0.4)
+        t0 = time.monotonic()
+        code, _ = _http(router.url + "/v1/predict",
+                        {"features": _x(1).tolist()})
+        elapsed = time.monotonic() - t0
+        # no token -> no hedge: the request rides out the slow primary
+        assert code == 200
+        assert elapsed >= 0.4
+        st = router.stats()
+        assert st["hedges"] == 0
+        assert st["retry_budget"]["exhausted_total"] >= 1
+    finally:
+        _stop_all(router, servers)
+
+
+def test_budget_exhaustion_degrades_to_single_attempt():
+    """A dead replica still in rotation + zero budget: requests that
+    draw the corpse get its 502 back (clean, single-attempt, no storm);
+    requests that draw the live replica succeed — and the router's
+    counters reconcile exactly with what the client saw."""
+    servers, router = _start_fleet(n=2, retry_budget_ratio=0.0,
+                                   retry_budget_min=0)
+    try:
+        router.poll_once()
+        servers[0].stop()  # dead, but NOT re-polled: stays in rotation
+        codes = []
+        for i in range(4):
+            code, _ = _http(router.url + "/v1/predict",
+                            {"features": _x(1, seed=i).tolist()})
+            codes.append(code)
+        # round-robin alternates primaries: half hit the corpse
+        assert sorted(codes) == [200, 200, 502, 502]
+        st = router.stats()
+        assert st["retries"] == 0                  # budget never allowed one
+        assert st["unroutable"] == 2               # == client-observed 5xx
+        assert st["retry_budget"]["exhausted_total"] == 2
+        ok = sum(p["latency_hist_s"]["count"]
+                 for p in st["priorities"].values())
+        total = sum(p["requests"] for p in st["priorities"].values())
+        assert ok == 2 and total == 4              # ok + unroutable == total
+    finally:
+        _stop_all(router, servers)
+
+
+def test_default_budget_allows_failover_retry():
+    servers, router = _start_fleet(n=2)
+    try:
+        router.poll_once()
+        servers[0].stop()
+        for i in range(4):
+            code, body = _http(router.url + "/v1/predict",
+                               {"features": _x(1, seed=i).tolist()})
+            assert code == 200, body  # fail-over retry absorbed the corpse
+        st = router.stats()
+        assert st["retries"] >= 1
+        assert st["unroutable"] == 0
+    finally:
+        _stop_all(router, servers)
+
+
+# -- staleness ----------------------------------------------------------------
+
+def test_stale_replica_excluded_from_fleet_aggregates():
+    servers, router = _start_fleet(n=2, stats_staleness_s=0.25)
+    try:
+        for i in range(4):
+            code, _ = _http(router.url + "/v1/predict",
+                            {"features": _x(1, seed=i).tolist()})
+            assert code == 200
+        router.poll_once()
+        st = router.stats()
+        total_rows = st["rows_by_policy"]["f32"]
+        assert total_rows == 4
+        assert all(not r["stale"] for r in st["replicas"])
+        servers[0].stop()
+        time.sleep(0.3)        # replica 0's last good poll ages past bound
+        router.poll_once()     # refreshes replica 1, fails on replica 0
+        st = router.stats()
+        by_idx = {r["index"]: r for r in st["replicas"]}
+        assert by_idx[0]["stale"] is True
+        assert by_idx[0]["last_ok_poll_age_s"] > 0.25
+        assert by_idx[1]["stale"] is False
+        # the dead replica's cached rows are history, not fleet state
+        assert st["rows_by_policy"]["f32"] == (
+            by_idx[1]["stats"]["rows"])
+        assert st["rows_by_policy"]["f32"] < total_rows
+        # ...and its serving families are gone from the /metrics page,
+        # while the staleness age itself IS exported
+        parsed = parse_prometheus_text(router_metrics(st))
+        reps = {dict(lbl).get("replica")
+                for lbl in parsed["dl4j_serving_rows_total"]}
+        assert reps == {"1"}
+        ages = {dict(lbl)["replica"]: v for lbl, v in
+                parsed["dl4j_router_replica_stats_age_seconds"].items()}
+        assert ages["0"] > 0.25
+    finally:
+        _stop_all(router, servers)
+
+
+# -- concurrent polling -------------------------------------------------------
+
+def test_concurrent_poll_is_not_serialized_by_a_wedged_replica():
+    servers, router = _start_fleet(n=3)
+    try:
+        servers[2].stop()  # one dead sibling that must still get ejected
+        # EVERY poll hangs 0.5s (router.poll fires once per replica):
+        # serial polling would cost >= 3 x 0.5s, concurrent ~0.5s
+        faults.arm("router.poll", "delay", delay_s=0.5, times=99)
+        t0 = time.monotonic()
+        healthy = router.poll_once()
+        elapsed = time.monotonic() - t0
+        assert elapsed < 1.2, f"polls serialized: {elapsed:.2f}s"
+        assert healthy == 2  # the wedge did not mask the dead sibling
+        assert faults.hits("router.poll") >= 3
+    finally:
+        _stop_all(router, servers)
+
+
+def test_poll_raise_counts_as_unready():
+    servers, router = _start_fleet(n=1)
+    try:
+        assert router.poll_once() == 1
+        faults.arm("router.poll", "raise")
+        assert router.poll_once() == 0   # injected failure = not ready
+        assert router.poll_once() == 1   # one-shot plan: recovers after
+    finally:
+        _stop_all(router, servers)
+
+
+# -- supervision --------------------------------------------------------------
+
+def _fleet_with_supervisor(n=2, **kw):
+    handles = [_Handle() for _ in range(n)]
+    router = Router([h.url for h in handles],
+                    poll_interval_s=3600.0).start()
+    kw.setdefault("backoff_fn", lambda attempt: 0.0)
+    sup = FleetSupervisor(spawn_fn=_Handle, router=router, initial=handles,
+                          min_replicas=n, max_replicas=n, **kw)
+    # not started: tests call tick() by hand for determinism
+    return handles, router, sup
+
+
+def test_supervisor_reaps_and_respawns_with_rereg():
+    handles, router, sup = _fleet_with_supervisor(n=2)
+    try:
+        handles[0].die(rc=-9)
+        sup.tick()                       # reap: out of rotation, backoff@0
+        assert len(router.replicas) == 1
+        st = sup.stats()
+        assert st["states"]["running"] == 1
+        sup.tick()                       # respawn due: new URL registered
+        assert len(router.replicas) == 2
+        assert router.poll_once() == 2
+        st = sup.stats()
+        assert st["restarts_total"] == 1
+        assert st["states"]["running"] == 2
+        # the healed slot re-registered its NEW ephemeral-port URL
+        respawned = [s for s in st["slots"] if s["restarts"] == 1]
+        assert respawned and router.find_replica(
+            respawned[0]["url"]) is not None
+        # traffic lands on the healed fleet
+        for i in range(4):
+            code, _ = _http(router.url + "/v1/predict",
+                            {"features": _x(1, seed=i).tolist()})
+            assert code == 200
+    finally:
+        sup.stop()
+        router.stop()
+        for h in sup.handles():
+            h.terminate()
+
+
+def test_supervisor_quarantines_crash_loop_then_probes():
+    handles, router, sup = _fleet_with_supervisor(
+        n=1, max_restarts=2, restart_window_s=100.0, quarantine_s=0.15)
+    try:
+        # every respawn fails at the spawn fault point: a deterministic
+        # crash-loop. death 1 -> backoff; failed spawn = death 2 ->
+        # quarantined (2 deaths in window), NOT hot-looped.
+        faults.arm("supervisor.spawn", "raise", times=1)
+        handles[0].die(rc=1)
+        sup.tick()                       # reap -> backoff(0)
+        sup.tick()                       # respawn attempt fails
+        st = sup.stats()
+        assert st["spawn_failures_total"] == 1
+        assert st["states"]["quarantined"] == 1
+        assert st["quarantines_total"] == 1
+        sup.tick()                       # quarantine holds: no spawn yet
+        assert sup.stats()["states"]["quarantined"] == 1
+        time.sleep(0.2)                  # quarantine elapses
+        sup.tick()                       # probe respawn (fault disarmed)
+        st = sup.stats()
+        assert st["states"]["running"] == 1
+        assert st["restarts_total"] == 1
+        assert router.poll_once() == 1
+    finally:
+        sup.stop()
+        router.stop()
+        for h in sup.handles():
+            h.terminate()
+
+
+def test_scale_down_drains_without_dropping_requests():
+    handles, router, sup = _fleet_with_supervisor(n=2)
+    sup.min_replicas = 1
+    results = {"codes": [], "errors": 0}
+    stop_load = threading.Event()
+
+    def loader():
+        i = 0
+        while not stop_load.is_set():
+            try:
+                code, _ = _http(router.url + "/v1/predict",
+                                {"features": _x(1, seed=i).tolist()},
+                                timeout=10)
+                results["codes"].append(code)
+            except Exception:
+                results["errors"] += 1
+            i += 1
+
+    threads = [threading.Thread(target=loader) for _ in range(3)]
+    try:
+        for t in threads:
+            t.start()
+        time.sleep(0.2)                  # load in flight
+        assert sup.scale_down() is True  # drain-then-stop the emptiest
+        time.sleep(0.2)                  # load continues on the survivor
+        stop_load.set()
+        for t in threads:
+            t.join(timeout=15.0)
+        assert results["errors"] == 0
+        assert results["codes"] and all(c == 200 for c in results["codes"])
+        st = sup.stats()
+        assert st["states"]["running"] == 1
+        assert st["states"]["stopped"] == 1
+        assert sup.scale_down() is False  # refuses below min_replicas
+    finally:
+        stop_load.set()
+        sup.stop()
+        router.stop()
+        for h in sup.handles():
+            h.terminate()
+
+
+def test_scale_up_bounded_by_max():
+    handles, router, sup = _fleet_with_supervisor(n=1)
+    sup.max_replicas = 2
+    try:
+        assert sup.scale_up() is True
+        assert len(router.replicas) == 2
+        assert sup.stats()["states"]["running"] == 2
+        assert sup.scale_up() is False   # at max
+    finally:
+        sup.stop()
+        router.stop()
+        for h in sup.handles():
+            h.terminate()
+
+
+# -- autoscaler ---------------------------------------------------------------
+
+class _SupProbe:
+    def __init__(self):
+        self.min_replicas, self.max_replicas = 1, 4
+        self.ups = 0
+        self.downs = 0
+        self.running = 2
+
+    def scale_up(self):
+        self.ups += 1
+        self.running += 1
+        return True
+
+    def scale_down(self):
+        self.downs += 1
+        self.running -= 1
+        return True
+
+    def running_count(self):
+        return self.running
+
+
+class _RepProbe:
+    def __init__(self, queue_depth=0, p99=10.0, breaker="closed",
+                 degraded=0):
+        self.ready = True
+        self._st = {"priorities": {"interactive":
+                                   {"queue_depth": queue_depth}},
+                    "latency_ms": {"p99": p99},
+                    "degraded_batches": degraded,
+                    "breaker": {"state": breaker}}
+
+    def stale(self, s):
+        return False
+
+    @property
+    def last_stats(self):
+        return self._st
+
+
+class _RouterProbe:
+    stats_staleness_s = 10.0
+
+    def __init__(self, reps):
+        self.replicas = reps
+
+
+def test_autoscaler_hysteresis_and_cooldown():
+    clk = _FakeClock()
+    sup = _SupProbe()
+    hot = _RouterProbe([_RepProbe(queue_depth=100), _RepProbe()])
+    a = Autoscaler(hot, sup, slo_p99_ms=500.0, consecutive=3,
+                   cooldown_s=30.0, clock=clk)
+    # one spiky evaluation does nothing; the streak must persist
+    assert a.evaluate_once() == "hold"
+    assert a.evaluate_once() == "hold"
+    assert a.evaluate_once() == "scale_up"
+    assert sup.ups == 1
+    # cooldown: the same raw signal cannot act again yet
+    for _ in range(5):
+        assert a.evaluate_once() == "hold"
+    assert sup.ups == 1
+    clk.t += 31.0                       # cooldown over; streak rebuilds
+    assert a.evaluate_once() == "hold"
+    assert a.evaluate_once() == "hold"
+    assert a.evaluate_once() == "scale_up"
+    assert sup.ups == 2
+    st = a.stats()
+    assert st["decisions"]["scale_up"] == 2
+    assert st["signals"]["queue_depth"] == 100
+
+
+def test_autoscaler_scales_down_idle_fleet_and_p99_breach_up():
+    clk = _FakeClock()
+    sup = _SupProbe()
+    idle = _RouterProbe([_RepProbe(queue_depth=0, p99=5.0),
+                         _RepProbe(queue_depth=0, p99=5.0)])
+    a = Autoscaler(idle, sup, slo_p99_ms=500.0, consecutive=2,
+                   cooldown_s=0.0, clock=clk)
+    assert a.evaluate_once() == "hold"
+    assert a.evaluate_once() == "scale_down"
+    assert sup.downs == 1
+    # p99 over the SLO is an up signal even with empty queues
+    slow = _RouterProbe([_RepProbe(queue_depth=0, p99=900.0)])
+    a2 = Autoscaler(slow, sup, slo_p99_ms=500.0, consecutive=1,
+                    cooldown_s=0.0, clock=clk)
+    assert a2.evaluate_once() == "scale_up"
+
+
+# -- Prometheus conformance ---------------------------------------------------
+
+def test_new_metric_families_parse_and_stay_monotonic():
+    handles, router, sup = _fleet_with_supervisor(n=2)
+    a = Autoscaler(router, sup, clock=time.monotonic)
+    router.attach_fleet(sup, a)
+    try:
+        a.evaluate_once()
+        text1 = router_metrics(router.stats())
+        parsed1 = parse_prometheus_text(text1)  # strict: raises on junk
+        for fam in ("dl4j_router_hedges_total",
+                    "dl4j_router_hedge_wins_total",
+                    "dl4j_router_retry_budget_remaining",
+                    "dl4j_router_retry_budget_exhausted_total",
+                    "dl4j_fleet_restarts_total",
+                    "dl4j_fleet_spawn_failures_total"):
+            assert fam in parsed1, fam
+        states = {dict(lbl)["state"]
+                  for lbl in parsed1["dl4j_fleet_replicas"]}
+        assert {"running", "backoff", "quarantined", "stopped"} <= states
+        assert parsed1["dl4j_fleet_replicas"][(("state", "running"),)] == 2
+        decisions = {dict(lbl)["decision"]
+                     for lbl in parsed1["dl4j_autoscaler_decisions_total"]}
+        assert decisions == {"scale_up", "scale_down", "hold"}
+        assert "dl4j_autoscaler_target_replicas" in parsed1
+        # traffic + a restart move the counters the right way only
+        for i in range(2):
+            _http(router.url + "/v1/predict",
+                  {"features": _x(1, seed=i).tolist()})
+        handles[0].die()
+        sup.tick()
+        sup.tick()
+        a.evaluate_once()
+        parsed2 = parse_prometheus_text(router_metrics(router.stats()))
+        for fam, series in parsed1.items():
+            if not fam.endswith("_total"):
+                continue
+            for lbl, v1 in series.items():
+                v2 = parsed2.get(fam, {}).get(lbl)
+                if v2 is not None:
+                    assert v2 >= v1, (fam, lbl, v1, v2)
+        assert parsed2["dl4j_fleet_restarts_total"][()] == 1
+    finally:
+        sup.stop()
+        router.stop()
+        for h in sup.handles():
+            h.terminate()
+
+
+# -- the acceptance smoke: CLI fleet, SIGKILL under load, heal, drain --------
+
+def test_cli_fleet_sigkill_heals_with_warm_cache_and_clean_answers(tmp_path):
+    """ISSUE 11 acceptance: SIGKILL one of 2 supervised replicas under
+    load -> zero incorrect responses (every client sees a correct
+    answer or a clean 5xx), the supervisor restores the fleet with
+    fresh_compiles == 0 on the respawn (shared warm disk cache), router
+    counters reconcile with client-observed outcomes, SIGTERM drain
+    exits 0."""
+    net = _net()
+    ckpt = str(tmp_path / "model")
+    cache = str(tmp_path / "cache")
+    checkpoint.save(ckpt, net.params, conf=net.conf)
+    x = _x(2, seed=1)
+    expected = np.asarray(net.output(x))
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    subprocess.run(
+        [sys.executable, "-m", "deeplearning4j_tpu.cli", "warmup",
+         "--model", ckpt, "--compile-cache", cache, "--shapes", "1,2"],
+        check=True, capture_output=True, cwd=repo, env=env, timeout=300)
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "deeplearning4j_tpu.cli", "serve",
+         "--model", ckpt, "--compile-cache", cache, "--shapes", "1,2",
+         "--replicas", "2", "--min-replicas", "2", "--max-replicas", "2",
+         "--hedge", "--port", "0", "--max-delay-ms", "2",
+         "--drain-timeout", "10"],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        cwd=repo, env=env)
+    try:
+        watchdog = threading.Timer(240.0, proc.kill)
+        watchdog.start()
+        try:
+            summary = json.loads(proc.stdout.readline())
+        finally:
+            watchdog.cancel()
+        url = summary["url"]
+        assert summary["fresh_compiles"] == [0, 0]
+        assert summary["hedge"] is True
+        assert len(summary["replica_pids"]) == 2
+        victim = summary["replica_pids"][0]
+
+        # open-ish loop: 4 client threads hammer while the kill lands;
+        # every answer must be bitwise-correct or a clean JSON 5xx
+        outcomes = {"ok": 0, "err5xx": 0, "bad": []}
+        lock = threading.Lock()
+        stop = threading.Event()
+
+        def client():
+            body = {"features": x.tolist()}
+            while not stop.is_set():
+                try:
+                    code, text = _http(url + "/v1/predict", body,
+                                       timeout=30)
+                except Exception as e:  # noqa: BLE001 — transport drop
+                    with lock:
+                        outcomes["bad"].append(f"transport: {e}")
+                    continue
+                if code == 200:
+                    out = np.asarray(json.loads(text)["output"])
+                    good = np.allclose(out, expected, atol=1e-5)
+                    with lock:
+                        if good:
+                            outcomes["ok"] += 1
+                        else:
+                            outcomes["bad"].append("wrong output")
+                elif 500 <= code < 600:
+                    json.loads(text)  # clean structured error, not junk
+                    with lock:
+                        outcomes["err5xx"] += 1
+                else:
+                    with lock:
+                        outcomes["bad"].append(f"code {code}")
+
+        threads = [threading.Thread(target=client) for _ in range(4)]
+        for t in threads:
+            t.start()
+        time.sleep(0.3)                      # load established
+        os.kill(victim, signal.SIGKILL)      # chaos
+        healed = None
+        deadline = time.monotonic() + 60.0
+        while time.monotonic() < deadline:
+            try:
+                code, text = _http(url + "/v1/stats", timeout=10)
+                st = json.loads(text)
+            except Exception:  # noqa: BLE001
+                time.sleep(0.2)
+                continue
+            fleet = st.get("fleet", {})
+            if (st.get("healthy_replicas", 0) >= 2
+                    and fleet.get("restarts_total", 0) >= 1):
+                healed = st
+                break
+            time.sleep(0.2)
+        time.sleep(0.3)                      # a little post-heal traffic
+        stop.set()
+        for t in threads:
+            t.join(timeout=30.0)
+        assert healed is not None, "fleet never healed within 60s"
+        assert healed["fleet"]["restarts_total"] >= 1
+        # the respawned replica came up from the warm shared disk cache
+        respawned = [s for s in healed["fleet"]["slots"]
+                     if s["restarts"] >= 1]
+        assert respawned and all(s["fresh_compiles"] == 0
+                                 for s in respawned), respawned
+        # zero incorrect responses, and the clients actually worked
+        assert outcomes["bad"] == [], outcomes["bad"][:5]
+        assert outcomes["ok"] > 0
+
+        # counters reconcile with client-observed outcomes: every
+        # request is either in the ok-latency histogram or unroutable
+        code, text = _http(url + "/v1/stats", timeout=10)
+        st = json.loads(text)
+        ok_count = sum(p["latency_hist_s"]["count"]
+                       for p in st["priorities"].values())
+        total = sum(p["requests"] for p in st["priorities"].values())
+        assert ok_count == outcomes["ok"]
+        assert st["unroutable"] == outcomes["err5xx"]
+        assert total == ok_count + st["unroutable"]
+
+        proc.send_signal(signal.SIGTERM)
+        out, err = proc.communicate(timeout=180)
+        assert proc.returncode == 0, (out, err)
+        drained = json.loads(out.strip().splitlines()[-1])
+        assert drained["drained"] is True
+        assert drained["restarts"] >= 1
+        assert all(rc == 0 for rc in drained["replica_exit_codes"])
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.communicate()
