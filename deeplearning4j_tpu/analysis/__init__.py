@@ -1,0 +1,53 @@
+"""Static analysis over compiled programs and repo conventions (ISSUE 12).
+
+Two complementary passes share one `Finding` report model:
+
+  - `program_audit` walks the jaxprs of every program in the AOT caches
+    (and any jitted fn handed to it) and flags what should never ship
+    in a compiled hot path: f64 ops, policy-crossing dtype promotions,
+    materialized [S,S] attention scores, undonated train-step buffers,
+    host callbacks, collectives in single-chip programs, large folded
+    constants.
+  - `repo_lint` parses the package's ASTs and enforces the repo's
+    written conventions: the platform-query choke point, injectable
+    clocks, the x64 guard, the fault-point and Prometheus-family
+    registries, lock discipline.
+
+Both feed `python -m deeplearning4j_tpu.cli analyze`, which renders one
+report (text or JSON) and exits nonzero at a chosen severity floor.
+"""
+
+from deeplearning4j_tpu.analysis.report import (
+    Finding,
+    REPORT_VERSION,
+    SEVERITIES,
+    at_or_above,
+    counts,
+    render_text,
+    severity_rank,
+    to_report,
+)
+from deeplearning4j_tpu.analysis.program_audit import (
+    assert_no_materialized_scores,
+    audit_cache,
+    audit_fn,
+    audit_jaxpr,
+    audit_zoo_models,
+    collect_shapes,
+    iter_eqns,
+    score_scale_shapes,
+)
+from deeplearning4j_tpu.analysis.repo_lint import (
+    lint_file,
+    lint_package,
+    lint_source,
+)
+
+__all__ = [
+    "Finding", "REPORT_VERSION", "SEVERITIES", "at_or_above", "counts",
+    "render_text", "severity_rank", "to_report",
+    "assert_no_materialized_scores", "audit_cache", "audit_fn",
+    "audit_jaxpr", "audit_zoo_models", "collect_shapes", "iter_eqns",
+    "score_scale_shapes",
+    "lint_file", "lint_package", "lint_source",
+]

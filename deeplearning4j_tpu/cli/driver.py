@@ -185,15 +185,14 @@ def cmd_train(args) -> int:
     t_train = _time.perf_counter()
     n_trained = data.num_examples() * epochs
     if args.runtime == "mesh":
-        import jax
-
+        from deeplearning4j_tpu.nd.platform import device_count
         from deeplearning4j_tpu.parallel.data_parallel import (
             DataParallelTrainer)
         from deeplearning4j_tpu.parallel.mesh import make_mesh
 
         net = MultiLayerNetwork(conf).init()
         _attach_compile_cache(net, args)
-        n_dev = len(jax.devices())
+        n_dev = device_count()
         mesh = make_mesh({"dp": n_dev})
         batch = int(props.get("batch", "128"))
         n = data.num_examples()
@@ -719,6 +718,27 @@ def cmd_serve_router(args) -> int:
     return 0 if rcs and all(rc == 0 for rc in rcs) else 1
 
 
+def cmd_analyze(args) -> int:
+    """Static analysis over the package and the zoo's compiled programs
+    (analysis/): AST convention lint + jaxpr program audit, one report,
+    exit 1 when any finding reaches the --fail-on severity."""
+    from deeplearning4j_tpu.analysis import (at_or_above, audit_zoo_models,
+                                             lint_package, render_text,
+                                             to_report)
+
+    findings, n_files = lint_package()
+    n_programs = 0
+    if not args.skip_programs:
+        prog_findings, n_programs = audit_zoo_models(small=True)
+        findings = findings + prog_findings
+    checked = {"files": n_files, "programs": n_programs}
+    if args.format == "json":
+        print(json.dumps(to_report(findings, checked)))
+    else:
+        print(render_text(findings, checked))
+    return 1 if at_or_above(findings, args.fail_on) else 0
+
+
 def _add_common(p: argparse.ArgumentParser) -> None:
     p.add_argument("--input", required=True,
                    help="mnist|iris|lfw|curves|cifar10|csv:<path>[:label_col]|"
@@ -902,6 +922,24 @@ def build_parser() -> argparse.ArgumentParser:
                         "policy cache key; f32 (default) stays bitwise-"
                         "identical to not passing the flag")
     s.set_defaults(fn=cmd_serve)
+
+    an = sub.add_parser(
+        "analyze",
+        help="static analysis: lint the package's ASTs against repo "
+             "conventions and audit the jaxprs of the zoo models' "
+             "compiled programs (analysis/)")
+    an.add_argument("--format", choices=["text", "json"], default="text",
+                    help="report rendering (json emits the versioned "
+                         "report schema tests assert on)")
+    an.add_argument("--fail-on", dest="fail_on",
+                    choices=["warn", "error"], default="error",
+                    help="exit 1 when any finding reaches this severity "
+                         "(default error)")
+    an.add_argument("--skip-programs", dest="skip_programs",
+                    action="store_true",
+                    help="lint only: skip compiling + auditing the zoo "
+                         "models' programs (fast pre-commit mode)")
+    an.set_defaults(fn=cmd_analyze)
     return ap
 
 

@@ -346,7 +346,7 @@ class PrefetchIterator:
         except BaseException as e:  # noqa: BLE001 — re-raised at next()
             self._put(q, stop, (self._ERROR, e))
 
-    def _start_unlocked(self) -> None:
+    def _start_locked(self) -> None:
         self.close()  # tear down any previous run
         if hasattr(self.base, "reset"):
             self.base.reset()
@@ -361,7 +361,7 @@ class PrefetchIterator:
         """(Re)start the pipeline; `__iter__` / the first `pull()` call
         this automatically."""
         with self._lock:
-            self._start_unlocked()
+            self._start_locked()
 
     # -- consumer ----------------------------------------------------------
     def pull(self):
@@ -377,7 +377,7 @@ class PrefetchIterator:
         blocked consumer."""
         with self._lock:
             if self._queue is None:
-                self._start_unlocked()
+                self._start_locked()
             q, stop = self._queue, self._stop
         while True:
             if stop.is_set():

@@ -63,7 +63,9 @@ class LSTMLayer:
         # "auto" for a new config.
         impl = getattr(conf, "lstm_impl", "auto")
         if impl == "auto":
-            return jax.devices()[0].platform == "tpu"
+            from deeplearning4j_tpu.nd.platform import is_tpu
+
+            return is_tpu()
         return impl == "fused"
 
     @staticmethod

@@ -86,10 +86,12 @@ def platform_info() -> dict:
     import jax
     import jaxlib
 
-    devs = jax.devices()
+    from deeplearning4j_tpu.nd import platform
+
+    devs = platform.devices()
     return {
         "format": FORMAT_VERSION,
-        "backend": jax.default_backend(),
+        "backend": platform.default_backend(),
         "device_kind": devs[0].device_kind if devs else "none",
         "n_devices": len(devs),
         "jax": jax.__version__,

@@ -60,6 +60,7 @@ from typing import Callable, Dict, List, Optional, Tuple
 import jax
 import jax.numpy as jnp
 
+from deeplearning4j_tpu.nd.platform import default_backend
 from deeplearning4j_tpu.optimize import solver as solver_mod
 from deeplearning4j_tpu.reliability import faults
 
@@ -149,6 +150,10 @@ class CompiledProgramCache:
         self._fixed_buckets = buckets is not None
         self._donate = donate
         self._persist = persist
+        # per-key audit records (builder, abstract args, donation) so the
+        # program auditor (analysis/program_audit.py) can re-trace and
+        # inspect every program this cache ever compiled
+        self._audit_records: Dict[Tuple, dict] = {}
         self.stats = StepCacheStats()
         # the serving gateway (and its batching-off control arm) reaches
         # this cache from many threads at once: lookup, bucket growth and
@@ -164,7 +169,8 @@ class CompiledProgramCache:
     def set_persist(self, store) -> None:
         """Attach (or detach with None) a `PersistentProgramStore` —
         already-compiled in-memory programs stay valid either way."""
-        self._persist = store
+        with self._lock:
+            self._persist = store
 
     # -- bucket policy ------------------------------------------------------
     def bucket_rows(self, n: int) -> int:
@@ -200,8 +206,17 @@ class CompiledProgramCache:
     def _donate_argnums(self) -> Tuple[int, ...]:
         donate = self._donate
         if donate is None:
-            donate = jax.default_backend() != "cpu"
+            donate = default_backend() != "cpu"
         return (0,) if donate else ()
+
+    def audit_records(self) -> List[dict]:
+        """Snapshot of the per-program audit records (one per compiled
+        or disk-restored key): {key, kind, build, abstract,
+        donate_argnums, mesh}.  `analysis.program_audit.audit_cache`
+        re-traces each builder against its abstract args to inspect the
+        jaxpr without executing anything."""
+        with self._lock:
+            return list(self._audit_records.values())
 
     def _get(self, key: Tuple, build: Callable[[], Callable], args: Tuple,
              shardings: Optional[Tuple] = None):
@@ -237,6 +252,10 @@ class CompiledProgramCache:
                     arg)
                 for arg, s in zip(args, shardings))
         donate = self._donate_argnums()
+        self._audit_records[key] = {
+            "key": key, "kind": self.kind, "build": build,
+            "abstract": abstract, "donate_argnums": donate,
+            "mesh": shardings is not None}
         if self._persist is not None:
             fn = self._load_from_disk(key, abstract, donate)
             self.stats.io_errors = self._persist.io_errors
@@ -344,6 +363,7 @@ class CompiledProgramCache:
     def clear(self) -> None:
         with self._lock:
             self._programs.clear()
+            self._audit_records.clear()
             self._buckets = (sorted(self._buckets) if self._fixed_buckets
                              else [])
             self.stats = StepCacheStats()

@@ -25,6 +25,8 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from deeplearning4j_tpu.nd import platform
+
 # canonical axis order, outermost first
 AXIS_ORDER = ("pp", "dp", "ep", "sp", "tp")
 
@@ -39,7 +41,7 @@ def make_mesh(shape: Optional[Dict[str, int]] = None,
     remaining devices".  Default: pure data parallelism over every device.
     """
     if devices is None:
-        devices = jax.devices()
+        devices = platform.devices()
     n = len(devices)
     if not shape:
         shape = {"dp": n}
@@ -93,7 +95,7 @@ def serve_mesh(devices=None) -> Mesh:
     mesh-sharded inference.  On a single-device host this degrades to a
     mesh of 1 — same program, no collectives."""
     if devices is None:
-        devices = jax.devices()
+        devices = platform.devices()
     return Mesh(np.asarray(devices).reshape(-1), (SERVE_AXIS,))
 
 

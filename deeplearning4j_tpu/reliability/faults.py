@@ -71,6 +71,34 @@ _sleep = time.sleep
 
 ENV_VAR = "DL4J_FAULT_PLAN"
 
+#: The machine-readable registry of every injection point product code
+#: traverses (the docstring above narrates the same set).  The repo
+#: linter (`analysis/repo_lint.py`, rule `fault-point`) enforces both
+#: directions: every `faults.fire("name")` site in the package must
+#: appear here, and every name here must have at least one fire site —
+#: an undocumented injection point is invisible to operators reading
+#: this registry, and a documented-but-dead one is a lie.
+DOCUMENTED_POINTS = {
+    "prefetch.worker": "per batch produced by PrefetchIterator's "
+                       "background thread (datasets/iterator.py)",
+    "persist.read": "disk-cache entry read (optimize/persist.py)",
+    "persist.write": "disk-cache entry write (optimize/persist.py); "
+                     "'corrupt' flips payload bytes",
+    "compile": "fresh trace+compile in the shared CompiledProgramCache "
+               "(optimize/step_cache.py)",
+    "dispatcher.execute": "per coalesced batch in the serving gateway's "
+                          "dispatcher (serving/batcher.py)",
+    "checkpoint.save": "atomic checkpoint write (parallel/checkpoint.py)",
+    "checkpoint.load": "checkpoint read (parallel/checkpoint.py)",
+    "trainer.step": "per batch in DataParallelTrainer.fit "
+                    "(parallel/data_parallel.py)",
+    "router.proxy": "per proxy attempt in Router.route_predict "
+                    "(serving/router.py)",
+    "router.poll": "per replica health poll (serving/router.py)",
+    "supervisor.spawn": "per replica (re)spawn attempt in FleetSupervisor "
+                        "(serving/supervisor.py)",
+}
+
 _PLAN_RE = re.compile(
     r"(?P<action>[a-z_]+)"
     r"(?::(?P<param>[0-9.]+))?"

@@ -77,7 +77,7 @@ def main(argv=None) -> int:
 
     # round 0 params come from the master so every worker starts identical
     net.set_params_flat(client.fetch(0))
-    t0 = time.time()
+    t0 = time.monotonic()
     mode = startup.get("mode", "bsp")
     for r in range(args.rounds):
         if args.slow:
@@ -95,7 +95,7 @@ def main(argv=None) -> int:
             client.update(np.asarray(net.params_flat()))
             client.progress(round=r, score=float(net.score(x, y)))
             net.set_params_flat(client.fetch(r + 1))  # polls til published
-    client.metrics_report({"fit_seconds": time.time() - t0,
+    client.metrics_report({"fit_seconds": time.monotonic() - t0,
                            "rounds": float(args.rounds)})
     client.complete()
     return 0

@@ -30,6 +30,61 @@ CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
 #: grows, +Inf catches anything larger
 BATCH_ROWS_BOUNDS = (1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024)
 
+#: THE declared registry of every metric family this module may emit:
+#: name -> (type, own label keys).  The repo linter
+#: (`analysis/repo_lint.py`, rule `prom-family`) enforces both
+#: directions against the emission calls below — an emitted family
+#: missing here, a declared family never emitted, a type mismatch, or
+#: an emission whose label keys stray outside the declared set all fail
+#: the build.  Two keys are implicit and allowed everywhere: `replica`
+#: (the router stamps it when re-exporting a replica's families) and
+#: `le` on histogram buckets.  Dashboards and alert rules key on these
+#: exact (name, labels) pairs: editing a declared set is a breaking
+#: change to every consumer, which is the point of declaring it.
+FAMILIES = {
+    "dl4j_serving_ready": ("gauge", ()),
+    "dl4j_serving_inflight": ("gauge", ()),
+    "dl4j_serving_precision_policy_info": ("gauge", ("policy",)),
+    "dl4j_serving_policy_rows_total": ("counter", ("policy",)),
+    "dl4j_serving_precision_accuracy_delta": ("gauge",
+                                              ("policy", "metric")),
+    "dl4j_serving_queue_depth": ("gauge", ("priority",)),
+    "dl4j_serving_requests_total": ("counter", ("priority",)),
+    "dl4j_serving_request_latency_seconds": ("histogram",
+                                             ("priority", "policy")),
+    "dl4j_serving_batch_rows": ("histogram", ()),
+    "dl4j_serving_rows_total": ("counter", ()),
+    "dl4j_serving_errors_total": ("counter", ()),
+    "dl4j_serving_deadline_misses_total": ("counter", ()),
+    "dl4j_serving_degraded_batches_total": ("counter", ()),
+    "dl4j_serving_breaker_state": ("gauge", ()),
+    "dl4j_serving_breaker_opens_total": ("counter", ()),
+    "dl4j_serving_cache_hits_total": ("counter", ("policy",)),
+    "dl4j_serving_cache_misses_total": ("counter", ("policy",)),
+    "dl4j_serving_cache_disk_hits_total": ("counter", ("policy",)),
+    "dl4j_serving_cache_io_errors_total": ("counter", ("policy",)),
+    "dl4j_router_ready": ("gauge", ()),
+    "dl4j_router_inflight": ("gauge", ()),
+    "dl4j_router_replicas_healthy": ("gauge", ()),
+    "dl4j_router_requests_total": ("counter", ("priority",)),
+    "dl4j_router_request_latency_seconds": ("histogram", ("priority",)),
+    "dl4j_router_retries_total": ("counter", ()),
+    "dl4j_router_unroutable_total": ("counter", ()),
+    "dl4j_router_hedges_total": ("counter", ()),
+    "dl4j_router_hedge_wins_total": ("counter", ()),
+    "dl4j_router_retry_budget_remaining": ("gauge", ()),
+    "dl4j_router_retry_budget_exhausted_total": ("counter", ()),
+    "dl4j_router_policy_rows_total": ("counter", ("policy",)),
+    "dl4j_router_replica_healthy": ("gauge", ("replica",)),
+    "dl4j_router_replica_breaker_state": ("gauge", ("replica",)),
+    "dl4j_router_replica_stats_age_seconds": ("gauge", ("replica",)),
+    "dl4j_fleet_replicas": ("gauge", ("state",)),
+    "dl4j_fleet_restarts_total": ("counter", ()),
+    "dl4j_fleet_spawn_failures_total": ("counter", ()),
+    "dl4j_autoscaler_decisions_total": ("counter", ("decision",)),
+    "dl4j_autoscaler_target_replicas": ("gauge", ()),
+}
+
 
 def escape_label_value(v) -> str:
     return (str(v).replace("\\", "\\\\").replace("\n", "\\n")

@@ -355,8 +355,9 @@ class Router:
     def attach_fleet(self, supervisor=None, autoscaler=None) -> None:
         """Attach the fleet control plane so `stats()` (and therefore
         /metrics) carries its `fleet` / `autoscaler` blocks."""
-        self._fleet = supervisor
-        self._autoscaler = autoscaler
+        with self._state_lock:
+            self._fleet = supervisor
+            self._autoscaler = autoscaler
 
     # -- admission ----------------------------------------------------------
     @property

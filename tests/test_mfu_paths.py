@@ -357,29 +357,15 @@ def test_fused_bwd_fallbacks_bitwise_vs_recompute(case):
     _assert_tree_bitwise(jg, rg, f"{case} jit")
 
 
-def _collect_avals(jaxpr, out):
-    for eqn in jaxpr.eqns:
-        for var in list(eqn.invars) + list(eqn.outvars):
-            aval = getattr(var, "aval", None)
-            if aval is not None and getattr(aval, "shape", None) is not None:
-                out.append(tuple(aval.shape))
-        for val in eqn.params.values():
-            for sub in (val if isinstance(val, (list, tuple)) else [val]):
-                inner = getattr(sub, "jaxpr", None)  # ClosedJaxpr
-                if inner is not None and hasattr(inner, "eqns"):
-                    _collect_avals(inner, out)
-                elif hasattr(sub, "eqns"):           # raw Jaxpr
-                    _collect_avals(sub, out)
-    return out
+# the recursive jaxpr walk that used to live here is library code now
+# (analysis/program_audit.py) so the `analyze` gate and this test assert
+# the exact same structural contract
+from deeplearning4j_tpu.analysis.program_audit import (  # noqa: E402
+    assert_no_materialized_scores as _assert_no_ss_lib)
 
 
 def _assert_no_ss(fn, args, S, where):
-    jaxpr = jax.make_jaxpr(fn)(*args)
-    shapes = _collect_avals(jaxpr.jaxpr, [])
-    offenders = [s for s in shapes
-                 if sum(1 for dim in s if dim >= S) >= 2]
-    assert not offenders, f"[S,S]-scale intermediates in {where}: " \
-                          f"{sorted(set(offenders))}"
+    _assert_no_ss_lib(fn, args, seq_threshold=S, where=where)
 
 
 @pytest.mark.parametrize("fused", [True, False])
