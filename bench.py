@@ -1657,6 +1657,105 @@ def bench_cold_start(devs) -> None:
                         "--compile-cache dir; trace+lower skipped")
 
 
+def bench_generate(devs) -> None:
+    """Autoregressive generation: continuous batching (freed decode
+    slots refilled every step) vs sequential batching (admissions wait
+    for the WHOLE table to drain — the barrier on the longest sequence).
+    Same model, same compiled decode/prefill programs, same
+    deterministic open-loop arrival schedule with mixed prompt/output
+    lengths; reports tokens/sec and TTFT p50/p99 per arm.  CPU-bound by
+    design: it measures the serving loop around the compiled step, not
+    the chip."""
+    import random as random_mod
+
+    from deeplearning4j_tpu.models.zoo import char_lstm
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.serving.batcher import ContinuousBatcher
+
+    # arrival rate deliberately outpaces decode capacity: a backlogged
+    # queue is where the sequential barrier's idle slots cost real
+    # throughput (an arrival-limited run hides it — both arms just
+    # keep up)
+    if SMALL:
+        n_requests, rate_rps, slots, max_seq = 16, 400.0, 4, 32
+    else:
+        n_requests, rate_rps, slots, max_seq = 64, 400.0, 8, 64
+    vocab = 24
+    net = MultiLayerNetwork(char_lstm(vocab, hidden=32, n_layers=1),
+                            seed=0).init()
+    # both arms replay the same programs: zero compiles inside the
+    # measured window
+    net.warmup_generate(slots=slots, max_seq=max_seq, prompt_buckets=(8,))
+
+    # one deterministic schedule both arms replay: Poisson arrivals,
+    # prompts of 2-6 tokens, outputs of 4-16 tokens
+    arr = random_mod.Random(0)
+    schedule = []
+    t_at = 0.0
+    for _ in range(n_requests):
+        prompt = [arr.randrange(1, vocab)
+                  for _ in range(arr.randrange(2, 7))]
+        schedule.append((t_at, prompt, arr.randrange(4, 17)))
+        t_at += arr.expovariate(rate_rps)
+
+    def run_arm(continuous: bool):
+        cb = ContinuousBatcher(net, n_slots=slots, max_seq=max_seq,
+                               prompt_buckets=(8,),
+                               max_pending=n_requests + 1,
+                               continuous=continuous)
+        lock = threading.Lock()
+        done: list = []
+
+        def consume(stream):
+            try:
+                toks = list(stream.tokens(timeout=120.0))
+            except Exception:
+                toks = []
+            with lock:
+                done.append((len(toks), stream.ttft_s))
+
+        threads = []
+        t_begin = time.perf_counter()
+        try:
+            for at, prompt, n_new in schedule:
+                now = time.perf_counter() - t_begin
+                if now < at:
+                    time.sleep(at - now)
+                s = cb.submit(prompt, max_new_tokens=n_new)
+                th = threading.Thread(target=consume, args=(s,))
+                th.start()
+                threads.append(th)
+            for th in threads:
+                th.join(timeout=150.0)
+            dt = time.perf_counter() - t_begin
+        finally:
+            cb.stop()
+        tokens = sum(n for n, _ in done)
+        ttfts = sorted(t for _, t in done if t is not None)
+
+        def pct(q):
+            if not ttfts:
+                return float("inf")
+            return ttfts[min(len(ttfts) - 1,
+                             int(q * (len(ttfts) - 1)))] * 1e3
+
+        return tokens / max(dt, 1e-9), pct(0.5), pct(0.99), tokens
+
+    seq_tps, seq_p50, seq_p99, seq_tokens = run_arm(False)
+    cont_tps, cont_p50, cont_p99, cont_tokens = run_arm(True)
+    _emit("generate sequential tokens/sec", seq_tps, "tokens/sec", None,
+          ttft_p50_ms=round(seq_p50, 2), ttft_p99_ms=round(seq_p99, 2),
+          tokens=seq_tokens, requests=n_requests, slots=slots,
+          baseline_note="admission barrier: the slot table drains to "
+                        "empty before the next batch admits")
+    _emit("generate continuous tokens/sec", cont_tps, "tokens/sec",
+          cont_tps / max(seq_tps, 1e-9),
+          ttft_p50_ms=round(cont_p50, 2), ttft_p99_ms=round(cont_p99, 2),
+          tokens=cont_tokens, requests=n_requests, slots=slots,
+          baseline_note="vs_baseline = continuous / sequential tokens/sec "
+                        "on the identical arrival schedule")
+
+
 # ---------------------------------------------------------------------------
 
 # BASELINE.json configs[0..4] first, heavyweight extras after — a degraded
@@ -1666,7 +1765,7 @@ BENCHES = [bench_lenet, bench_char_lstm, bench_vgg_cifar10, bench_word2vec,
            bench_elastic_resume,
            bench_char_lstm4, bench_step_cache, bench_infer_latency,
            bench_serve, bench_serve_precision, bench_serve_router,
-           bench_fleet_slo,
+           bench_fleet_slo, bench_generate,
            bench_prefetch,
            bench_cold_start, bench_north_star_cli,
            bench_attention_fused_bwd, bench_attention_crossover,

@@ -22,6 +22,12 @@ invariants:
   undonated-step         a train-step program compiled without donating
                          its params buffer where donation is available
                          (double-buffers every parameter in HBM)
+  undonated-kv-cache     a decode/prefill program compiled without
+                         donating its decode-state buffers where
+                         donation is available — the KV cache is the
+                         largest live buffer in a generation server,
+                         and an undonated one is double-buffered every
+                         single token
   host-callback          a host callback / infeed / outfeed primitive
                          inside a compiled hot path (each one is a
                          device->host round trip per step)
@@ -290,6 +296,15 @@ def audit_cache(cache, *, expect_donation: Optional[bool] = None,
                 "undonated-step", "error", f"program:{where}",
                 "train-step program compiled without donating its params "
                 "buffer — every parameter is double-buffered in HBM"))
+        if (rec["kind"] == "infer-cache" and rec["key"]
+                and rec["key"][0] in ("decode", "prefill")
+                and not rec["donate_argnums"]
+                and _donation_expected(expect_donation)):
+            findings.append(Finding(
+                "undonated-kv-cache", "error", f"program:{where}",
+                f"{rec['key'][0]} program compiled without donating its "
+                f"decode-state buffers — the KV cache is double-buffered "
+                f"in HBM on every token"))
         closed = jax.make_jaxpr(rec["build"]())(*rec["abstract"])
         findings.extend(audit_jaxpr(
             closed, where=where, policy=policy,
@@ -321,6 +336,7 @@ def audit_zoo_models(small: bool = True, rows: int = 4,
     tier-1 gate run: the invariant floor, checked on the programs that
     actually ship."""
     from deeplearning4j_tpu.models.zoo import precision_eval_confs
+    from deeplearning4j_tpu.nn.decode import check_generative
     from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
     from deeplearning4j_tpu.optimize.quantize import default_calibration
 
@@ -331,6 +347,15 @@ def audit_zoo_models(small: bool = True, rows: int = 4,
         x = default_calibration(conf, rows)
         out = net.output(x)                    # compiles the serve program
         net.finetune(x, _zoo_labels(out))      # compiles the train step
+        try:
+            check_generative(conf)
+        except ValueError:
+            pass
+        else:
+            # generative models also ship decode + prefill programs —
+            # compile them through the same cache so the donation and
+            # jaxpr rules see exactly what a generation server runs
+            net.warmup_generate(slots=2, max_seq=8, prompt_buckets=(4,))
         for cache in (net.step_cache, net.infer_cache):
             recs = cache.audit_records()
             n_programs += len(recs)
@@ -340,6 +365,8 @@ def audit_zoo_models(small: bool = True, rows: int = 4,
                                         f"{name}/{f.location}", f.message))
     findings.extend(audit_attention_structure())
     n_programs += 2
+    findings.extend(audit_decode_structure())
+    n_programs += 1
     return findings, n_programs
 
 
@@ -365,3 +392,32 @@ def audit_attention_structure(S: int = 1024, D: int = 8) -> List[Finding]:
         jax.grad(lambda a, b, c: jnp.sum(fwd(a, b, c)), argnums=(0, 1, 2)),
         (q, q, q), where=f"flash-bwd:S={S}", seq_threshold=S)
     return findings
+
+
+def audit_decode_structure(S: int = 1024) -> List[Finding]:
+    """Trace-only structural check of the KV-cache decode step at a
+    cache length where an [S,S] materialization is unambiguous: the
+    whole point of the decode program is [B,1]-query attention against a
+    [B,S] cache, so scores stay [B,H,S] — ONE sequence axis — however
+    long the cache grows.  (Prefill is deliberately not checked here:
+    it legitimately materializes [T,T] causal scores at prompt-bucket
+    scale, which is bounded and paid once per stream.)"""
+    import jax
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.models.zoo import char_transformer
+    from deeplearning4j_tpu.nn import decode as decode_mod
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+    conf = char_transformer(24, d_model=16, n_blocks=1, n_heads=2,
+                            max_seq_len=S)
+    net = MultiLayerNetwork(conf, seed=0).init()
+    state = decode_mod.init_state(conf, 1, S)
+    tok = jnp.zeros((1,), jnp.int32)
+    pos = jnp.zeros((1,), jnp.int32)
+
+    def step(params, state, tok, pos):
+        return decode_mod.decode_step(conf, params, state, tok, pos)
+
+    return audit_fn(step, (net.params, state, tok, pos),
+                    where=f"decode-step:S={S}", seq_threshold=S)

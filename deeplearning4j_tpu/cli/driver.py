@@ -440,6 +440,12 @@ def cmd_warmup(args) -> int:
         raise SystemExit("warmup needs --shapes (e.g. 256,1024 or 32x784)")
     entries = tuple(e.strip() for e in args.entries.split(",") if e.strip())
     summary = net.warmup(shapes, entries=entries, train=args.train)
+    if getattr(args, "generate", False):
+        # generation programs land in the same persistent store, so a
+        # later `serve --generate` with matching gen_* flags starts
+        # with fresh_compiles == 0
+        summary["generation"] = _warm_generate(net, args)
+        summary["infer_cache"] = net.infer_cache.stats.as_dict()
     summary["precision"] = net.serve_precision
     summary["disk_cache"] = _disk_stats(net)
     print(json.dumps(summary))
@@ -456,6 +462,71 @@ def _parse_shapes(spec: str):
         dims = tuple(int(d) for d in part.split("x"))
         shapes.append(dims[0] if len(dims) == 1 else dims)
     return shapes
+
+
+def _parse_buckets(spec: str):
+    """'4,8' -> (4, 8): prompt-token buckets the prefill program pads
+    into (one compiled prefill per bucket)."""
+    out = tuple(int(p) for p in (spec or "").split(",") if p.strip())
+    if not out:
+        raise SystemExit("expected a comma-separated bucket list like 4,8")
+    return out
+
+
+def _warm_generate(net, args) -> dict:
+    """Compile the decode + prefill programs for the gen_* flags (shared
+    by serve --generate, warmup --generate, and the generate command) —
+    always BEFORE traffic, so generation starts from cache hits."""
+    summary = net.warmup_generate(
+        slots=args.gen_slots, max_seq=args.gen_max_seq,
+        prompt_buckets=_parse_buckets(args.gen_prompt_buckets))
+    summary.pop("infer_cache", None)  # _build_server reports cache stats
+    return summary
+
+
+def cmd_generate(args) -> int:
+    """One-shot autoregressive generation through the compiled KV-cache
+    decode path: prefill consumes the prompt, then the continuous
+    batcher's decode loop produces each token (n_slots=1 here; `serve
+    --generate` runs the multi-slot table behind POST /v1/generate)."""
+    import time
+
+    from deeplearning4j_tpu.serving.batcher import ContinuousBatcher
+
+    net = _load_model(args.model)
+    _attach_compile_cache(net, args)
+    prompt = [int(t) for t in args.prompt.split(",") if t.strip()]
+    if not prompt:
+        raise SystemExit("generate needs --prompt <id,id,...>")
+    if len(prompt) >= args.gen_max_seq:
+        raise SystemExit(f"prompt of {len(prompt)} tokens needs "
+                         f"--gen-max-seq > {len(prompt)}")
+    bucket = max(4, 1 << (len(prompt) - 1).bit_length())
+    net.warmup_generate(slots=1, max_seq=args.gen_max_seq,
+                        prompt_buckets=(min(bucket, args.gen_max_seq),))
+    warmed_misses = net.infer_cache.stats.misses
+    batcher = ContinuousBatcher(net, n_slots=1, max_seq=args.gen_max_seq,
+                                prompt_buckets=(min(bucket,
+                                                    args.gen_max_seq),))
+    try:
+        t0 = time.perf_counter()
+        stream = batcher.submit(prompt,
+                                max_new_tokens=args.max_new_tokens,
+                                temperature=args.temperature,
+                                rng_seed=args.seed)
+        tokens = list(stream.tokens(timeout=args.timeout))
+        dt = time.perf_counter() - t0
+    finally:
+        batcher.stop()
+    print(json.dumps({
+        "tokens": tokens,
+        "n_tokens": len(tokens),
+        "tokens_per_sec": round(len(tokens) / max(dt, 1e-9), 2),
+        "ttft_ms": (None if stream.ttft_s is None
+                    else round(stream.ttft_s * 1000.0, 3)),
+        "fresh_compiles": net.infer_cache.stats.misses - warmed_misses,
+        "disk_cache": _disk_stats(net)}))
+    return 0
 
 
 def _build_server(args):
@@ -483,6 +554,12 @@ def _build_server(args):
         # are disk restores, and steady-state serving (requests padding
         # into the warmed buckets) does zero fresh compiles
         warmed = net.warmup(shapes, entries=("output",))["shapes"]
+    generate = bool(getattr(args, "generate", False))
+    gen_warmed = None
+    if generate:
+        # same rule as the predict buckets: the decode + prefill
+        # programs compile (or disk-restore) before the socket opens
+        gen_warmed = _warm_generate(net, args)
     server = net.serve(host=args.host, port=args.port,
                        max_delay_ms=args.max_delay_ms,
                        max_pending=args.max_pending,
@@ -493,13 +570,21 @@ def _build_server(args):
                        drain_timeout_s=getattr(args, "drain_timeout", 10.0),
                        default_deadline_ms=getattr(args,
                                                    "default_deadline_ms",
-                                                   None))
+                                                   None),
+                       generate=generate,
+                       gen_slots=getattr(args, "gen_slots", 4),
+                       gen_max_seq=getattr(args, "gen_max_seq", 64),
+                       gen_prompt_buckets=_parse_buckets(
+                           getattr(args, "gen_prompt_buckets", "8"))
+                       if generate else (8,),
+                       gen_max_pending=getattr(args, "gen_max_pending", 64))
     summary = {"url": server.url, "warmed": warmed,
                "fresh_compiles": net.infer_cache.stats.misses,
                "batching": not args.no_batching,
                "mesh_devices": mesh_devices,
                "precision": net.serve_precision,
                "precision_report": precision_report,
+               "generation": gen_warmed,
                "disk_cache": _disk_stats(net)}
     return net, server, summary
 
@@ -760,6 +845,33 @@ def _add_common(p: argparse.ArgumentParser) -> None:
                         "warmup subcommand to prefill it)")
 
 
+def _add_generate_flags(p: argparse.ArgumentParser) -> None:
+    """Continuous-batching generation flags shared by `serve --generate`
+    and `warmup --generate` (matching flags → matching cache keys, so a
+    warmed serve process starts generating with zero fresh compiles)."""
+    p.add_argument("--generate", action="store_true",
+                   help="compile the autoregressive decode + prefill "
+                        "programs; on serve, also run the continuous-"
+                        "batching decode loop behind POST /v1/generate")
+    p.add_argument("--gen-slots", dest="gen_slots", type=int, default=4,
+                   help="decode slot-table width: concurrent generation "
+                        "streams per device call (one compiled decode "
+                        "step over the whole table)")
+    p.add_argument("--gen-max-seq", dest="gen_max_seq", type=int,
+                   default=64,
+                   help="KV-cache length per slot; prompt + generated "
+                        "tokens must fit in it")
+    p.add_argument("--gen-prompt-buckets", dest="gen_prompt_buckets",
+                   default="8",
+                   help="comma-separated prompt-token buckets; each "
+                        "admission pads its prompt into the smallest "
+                        "fitting bucket (one compiled prefill per bucket)")
+    p.add_argument("--gen-max-pending", dest="gen_max_pending", type=int,
+                   default=64,
+                   help="queued generation streams bound; beyond it "
+                        "submissions get 503")
+
+
 def build_parser() -> argparse.ArgumentParser:
     ap = argparse.ArgumentParser(
         prog="dl4j-tpu", description="TPU-native deep learning CLI")
@@ -832,7 +944,37 @@ def build_parser() -> argparse.ArgumentParser:
                         "the quantized-weights artifact — carry the policy "
                         "cache key a `serve --precision` process will look "
                         "up)")
+    _add_generate_flags(w)
     w.set_defaults(fn=cmd_warmup)
+
+    g = sub.add_parser(
+        "generate",
+        help="autoregressive generation from a checkpoint through the "
+             "compiled KV-cache decode path (one prefill + one decode "
+             "step per token)")
+    g.add_argument("--model", required=True,
+                   help="checkpoint dir (or conf JSON) of a generative "
+                        "model (char_lstm / char_transformer)")
+    g.add_argument("--compile-cache", dest="compile_cache", default=None,
+                   metavar="DIR",
+                   help="persistent compile cache (see warmup --generate)")
+    g.add_argument("--prompt", required=True,
+                   help="comma-separated prompt token ids, e.g. 1,7,3")
+    g.add_argument("--max-new-tokens", dest="max_new_tokens", type=int,
+                   default=16,
+                   help="tokens to generate (clamped so prompt + output "
+                        "fit --max-seq)")
+    g.add_argument("--temperature", type=float, default=0.0,
+                   help="0 decodes greedily; >0 samples with this "
+                        "temperature")
+    g.add_argument("--seed", type=int, default=0,
+                   help="PRNG seed for temperature sampling")
+    g.add_argument("--max-seq", dest="gen_max_seq", type=int, default=64,
+                   help="KV-cache length: prompt + generated tokens "
+                        "must fit in it")
+    g.add_argument("--timeout", type=float, default=120.0,
+                   help="bound on the whole generation (seconds)")
+    g.set_defaults(fn=cmd_generate)
 
     s = sub.add_parser("serve",
                        help="micro-batching HTTP gateway: POST "
@@ -921,6 +1063,7 @@ def build_parser() -> argparse.ArgumentParser:
                         "BEFORE warmup so warmed programs carry the "
                         "policy cache key; f32 (default) stays bitwise-"
                         "identical to not passing the flag")
+    _add_generate_flags(s)
     s.set_defaults(fn=cmd_serve)
 
     an = sub.add_parser(

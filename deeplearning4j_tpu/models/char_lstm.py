@@ -146,6 +146,43 @@ class CharLSTM:
             logp, hs, cs = step(eye[cid][None], hs, cs)
         return "".join(out)
 
+    def generate(self, seed_text: str, n: int = 50,
+                 temperature: float = 0.0, rng_seed: int = 0,
+                 max_seq: Optional[int] = None) -> str:
+        """`sample()` through the compiled KV-cache decode path: one
+        prefill program consumes the seed text, then one decode-step
+        program (compiled once, state donated) produces each character.
+        Token-for-token identical to `sample()` for the same arguments —
+        both split the same PRNG key stream and the recurrent math is
+        the same f32 ops — which is exactly what
+        tests/test_generate.py pins."""
+        assert self.net is not None, "fit() first"
+        ids = self._encode(seed_text)
+        if len(ids) == 0:
+            raise ValueError("seed_text must be non-empty")
+        if max_seq is None:
+            max_seq = max(8, 1 << (len(ids) + n - 1).bit_length())
+        bucket = max(4, 1 << (len(ids) - 1).bit_length())
+        ic = self.net.infer_cache
+        state = ic.init_decode_state(self.net.conf, 1, max_seq)
+        prompt = np.zeros((1, bucket), np.int32)
+        prompt[0, :len(ids)] = ids
+        length = jnp.asarray([len(ids)], jnp.int32)
+        keys = jnp.asarray(np.asarray(jax.random.PRNGKey(rng_seed))[None])
+        temps = jnp.full((1,), float(temperature), jnp.float32)
+        tok, keys, state = ic.prefill(
+            self.net.conf, self.net.params, state, jnp.asarray(prompt),
+            length, keys, temps)
+        out = [self.chars[int(tok[0])]]
+        pos = jnp.asarray([len(ids)], jnp.int32)
+        for _ in range(n - 1):
+            tok, keys, state = ic.decode(
+                self.net.conf, self.net.params, state, tok, pos, keys,
+                temps)
+            out.append(self.chars[int(tok[0])])
+            pos = pos + 1
+        return "".join(out)
+
     def beam_search(self, seed_text: str, n: int = 20,
                     beam_width: int = 4) -> Tuple[str, float]:
         """Beam-search decode (LSTM.java:236-341 parity): returns the best

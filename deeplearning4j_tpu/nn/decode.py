@@ -1,0 +1,189 @@
+"""Cache-aware autoregressive decoding over a stacked network.
+
+The serving generation path (ISSUE 14) splits a generative forward into
+two compiled programs instead of re-running the whole prefix every token:
+
+  prefill      run the prompt once through the normal sequence forward,
+               recording attention K/V rows into pre-allocated
+               [B, max_S, n] caches (LSTM carries (h, c) the same way),
+               and return the next-token log-probs at each row's last
+               real prompt position.
+  decode_step  advance every row by ONE token against the recorded
+               state: attention scores are [B, H, max_S] — one
+               sequence-scaled axis, never a materialized [S, S] — and
+               the LSTM applies its per-step cell exactly as the eager
+               `models/char_lstm.py` sampler does.
+
+Both entries return `log(clip(probs, 1e-9, 1))` — byte-for-byte the
+transform the eager sampler applies — so a greedy compiled decode
+reproduces the eager token trajectory exactly in f32.  The compiled
+wrappers (key schema, donation, sampling) live in
+`optimize/infer_cache.py`; this module is pure layer math.
+
+State layout: one dict per layer, in layer order, as a tuple —
+  LSTM/GRAVES_LSTM  {"h": [B, H] f32, "c": [B, H] f32}
+  ATTENTION         {"k": [B, max_S, n] compute_dtype, "v": same}
+  everything else   {}
+The tuple-of-dicts shape makes the whole state one donatable jit
+argument whose leaves keep their shapes/dtypes across steps, so the
+compiled step can alias its cache buffers in place.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn.conf import LayerType, MultiLayerConfiguration
+from deeplearning4j_tpu.nn.layers import get_layer
+from deeplearning4j_tpu.nn.layers.base import compute_dtype
+from deeplearning4j_tpu.nn.layers.output import OutputLayer
+
+#: hidden layer types the decode path knows how to step one token at a time
+GENERATIVE_HIDDEN = (LayerType.LSTM, LayerType.GRAVES_LSTM,
+                     LayerType.ATTENTION, LayerType.TRANSFORMER_FFN)
+
+_RECURRENT = (LayerType.LSTM, LayerType.GRAVES_LSTM)
+
+
+def check_generative(conf: MultiLayerConfiguration):
+    """Validate that `conf` is a decodable generative stack and return
+    its layer types: optional leading EMBEDDING, then
+    LSTM/GRAVES_LSTM/ATTENTION/TRANSFORMER_FFN hidden layers (causal
+    attention only), then a final OUTPUT layer; the only preprocessor
+    allowed is the trailing rnn_to_ff (which the per-token decode skips —
+    its activations are already [B, n])."""
+    n = conf.n_layers
+    if n < 2:
+        raise ValueError("generation needs at least one hidden layer "
+                         "and an OUTPUT layer")
+    types = [LayerType(str(conf.conf(i).layer_type)) for i in range(n)]
+    if types[-1] != LayerType.OUTPUT:
+        raise ValueError(f"last layer must be OUTPUT, got {types[-1]}")
+    start = 1 if types[0] == LayerType.EMBEDDING else 0
+    for i, t in enumerate(types[start:-1], start):
+        if t not in GENERATIVE_HIDDEN:
+            raise ValueError(
+                f"layer {i} ({t}) has no single-token decode path; "
+                f"generative stacks may use {[str(x) for x in GENERATIVE_HIDDEN]}")
+        if t == LayerType.ATTENTION and not conf.conf(i).causal:
+            raise ValueError(
+                f"layer {i}: only causal attention can decode "
+                f"autoregressively")
+    for idx, name in conf.input_preprocessors:
+        if not (idx == n - 1 and name == "rnn_to_ff"):
+            raise ValueError(
+                f"preprocessor {name!r} at layer {idx} is incompatible "
+                f"with token decoding (only the trailing rnn_to_ff is)")
+    return types
+
+
+def init_state(conf: MultiLayerConfiguration, batch: int, max_seq: int):
+    """Fresh decode state for `batch` rows and a `max_seq`-token table."""
+    types = check_generative(conf)
+    if types[0] == LayerType.EMBEDDING:
+        table = conf.conf(0).max_seq_len
+        if table and max_seq > table:
+            raise ValueError(
+                f"max_seq={max_seq} exceeds the learned positional table "
+                f"(max_seq_len={table})")
+    state = []
+    for i, t in enumerate(types):
+        c = conf.conf(i)
+        if t in _RECURRENT:
+            # f32 like the eager sampler's zeros-init carries
+            state.append({"h": jnp.zeros((batch, c.n_out), jnp.float32),
+                          "c": jnp.zeros((batch, c.n_out), jnp.float32)})
+        elif t == LayerType.ATTENTION:
+            cd = compute_dtype(c)
+            state.append({"k": jnp.zeros((batch, max_seq, c.n_in), cd),
+                          "v": jnp.zeros((batch, max_seq, c.n_in), cd)})
+        else:
+            state.append({})
+    return tuple(state)
+
+
+def token_embed(conf: MultiLayerConfiguration, params, tok, pos):
+    """Embed one token id per row: EMBEDDING stacks gather W[tok]
+    (+ P[pos] rowwise when a positional table exists — NOT
+    EmbeddingLayer.forward, whose P[:s] convention would misread a [B]
+    id vector as a length-B sequence); one-hot stacks build the same
+    f32 rows the eager sampler feeds (`eye[cid]`)."""
+    c0 = conf.conf(0)
+    if LayerType(str(c0.layer_type)) == LayerType.EMBEDDING:
+        e = params[0]["W"][tok]
+        if "P" in params[0]:
+            e = e + params[0]["P"][pos]
+        return e
+    return jax.nn.one_hot(tok, c0.n_in, dtype=jnp.float32)
+
+
+def decode_step(conf: MultiLayerConfiguration, params, state, tok, pos):
+    """Advance every row one token: tok [B] int32 (the row's current
+    token), pos [B] int32 (the sequence position that token occupies).
+    Returns (logp [B, vocab] — log(clip(probs)) for the NEXT token —
+    and the updated state tuple)."""
+    types = check_generative(conf)
+    x = token_embed(conf, params, tok, pos)
+    new_state = []
+    for i, t in enumerate(types[:-1]):
+        c = conf.conf(i)
+        impl = get_layer(c.layer_type)
+        if t in _RECURRENT:
+            h, cc = impl.step(params[i], c, x, state[i]["h"], state[i]["c"])
+            new_state.append({"h": h, "c": cc})
+            x = h
+        elif t == LayerType.ATTENTION:
+            x, kc, vc = impl.decode_step(params[i], c, x, state[i]["k"],
+                                         state[i]["v"], pos)
+            new_state.append({"k": kc, "v": vc})
+        elif t == LayerType.TRANSFORMER_FFN:
+            x = impl.forward(params[i], c, x)
+            new_state.append({})
+        else:  # EMBEDDING — consumed by token_embed above
+            new_state.append({})
+    out_conf = conf.conf(len(types) - 1)
+    probs = OutputLayer.forward(params[len(types) - 1], out_conf, x)
+    new_state.append({})
+    return jnp.log(jnp.clip(probs, 1e-9, 1.0)), tuple(new_state)
+
+
+def prefill(conf: MultiLayerConfiguration, params, state, prompt, length):
+    """Fill the decode state from a prompt bucket: prompt [B, T] int32
+    (zero-padded past each row's true `length`), length [B] int32 >= 1.
+    Returns (logp [B, vocab] at each row's LAST real prompt position —
+    what the first generated token samples from — and the filled state).
+
+    Padding is inert by construction: LSTM carries freeze at
+    t >= length, attention's causal mask hides later positions from
+    every real one, and `decode_step` overwrites cache position `pos`
+    before attending to it."""
+    types = check_generative(conf)
+    c0 = conf.conf(0)
+    if types[0] == LayerType.EMBEDDING:
+        x = get_layer(c0.layer_type).forward(params[0], c0, prompt)
+    else:
+        x = jax.nn.one_hot(prompt, c0.n_in, dtype=jnp.float32)
+    new_state = []
+    for i, t in enumerate(types[:-1]):
+        c = conf.conf(i)
+        impl = get_layer(c.layer_type)
+        if t in _RECURRENT:
+            x, h, cc = impl.prefill(params[i], c, x, state[i]["h"],
+                                    state[i]["c"], length)
+            new_state.append({"h": h, "c": cc})
+        elif t == LayerType.ATTENTION:
+            x, kc, vc = impl.prefill(params[i], c, x, state[i]["k"],
+                                     state[i]["v"])
+            new_state.append({"k": kc, "v": vc})
+        elif t == LayerType.TRANSFORMER_FFN:
+            x = impl.forward(params[i], c, x)
+            new_state.append({})
+        else:  # EMBEDDING
+            new_state.append({})
+    b = prompt.shape[0]
+    last = x[jnp.arange(b), length - 1]
+    out_conf = conf.conf(len(types) - 1)
+    probs = OutputLayer.forward(params[len(types) - 1], out_conf, last)
+    new_state.append({})
+    return jnp.log(jnp.clip(probs, 1e-9, 1.0)), tuple(new_state)

@@ -65,6 +65,20 @@ class MultiHeadAttentionLayer:
         q = q.reshape(b, s, h, hd)
         k = k.reshape(b, s, h, hd)
         v = v.reshape(b, s, h, hd)
+        o = MultiHeadAttentionLayer._attend(conf, q, k, v)
+        o = mixed_matmul(o.reshape(b, s, n).astype(x.dtype),
+                         params["Wo"], conf) + params["bo"]
+        if training and conf.dropout > 0.0 and key is not None:
+            o = o * ndr.dropout_mask(key, 1.0 - conf.dropout, o.shape, o.dtype)
+        return x + o
+
+    @staticmethod
+    def _attend(conf, q, k, v):
+        """Impl dispatch shared by `forward` and `prefill` — q/k/v are
+        [b, s, h, hd] and the result matches elementwise whichever path
+        produced the projections (prefill hidden states are bitwise equal
+        to a plain forward over the same prompt)."""
+        b, s, h, hd = q.shape
         blk = conf.attention_block_size
         skip = conf.attention_block_skip and conf.causal
         fused_bwd = conf.attention_fused_bwd
@@ -114,11 +128,75 @@ class MultiHeadAttentionLayer:
                                     causal=conf.causal)
         else:
             o = full_attention(q, k, v, causal=conf.causal)
+        return o
+
+    @staticmethod
+    def prefill(params, conf, x, k_cache, v_cache):
+        """Prompt phase of KV-cache generation: run the normal causal
+        forward over the whole prompt and record the projected K/V rows
+        into the pre-allocated caches.
+
+        x: [B, T, n]; caches: [B, max_S, n] (T <= max_S).  Returns
+        (hidden [B, T, n], k_cache, v_cache).  Bucket padding beyond each
+        row's true prompt length writes junk K/V at positions >= length,
+        which is harmless: the causal mask hides them from every prompt
+        position, and `decode_step` overwrites position `pos` before it
+        ever attends to it.
+        """
+        b, s, n = x.shape
+        h = conf.n_heads
+        hd = n // h
+        cd = compute_dtype(conf)
+        xn = _layer_norm(x, params["ln_g"], params["ln_b"])
+        qkv = mixed_matmul(xn, params["Wqkv"], conf) + params["bqkv"]
+        q, k, v = jnp.split(qkv.astype(cd), 3, axis=-1)
+        k_cache = jax.lax.dynamic_update_slice(
+            k_cache, k.astype(k_cache.dtype), (0, 0, 0))
+        v_cache = jax.lax.dynamic_update_slice(
+            v_cache, v.astype(v_cache.dtype), (0, 0, 0))
+        q = q.reshape(b, s, h, hd)
+        k = k.reshape(b, s, h, hd)
+        v = v.reshape(b, s, h, hd)
+        o = MultiHeadAttentionLayer._attend(conf, q, k, v)
         o = mixed_matmul(o.reshape(b, s, n).astype(x.dtype),
                          params["Wo"], conf) + params["bo"]
-        if training and conf.dropout > 0.0 and key is not None:
-            o = o * ndr.dropout_mask(key, 1.0 - conf.dropout, o.shape, o.dtype)
-        return x + o
+        return x + o, k_cache, v_cache
+
+    @staticmethod
+    def decode_step(params, conf, x, k_cache, v_cache, pos):
+        """One generated token against the KV cache.
+
+        x: [B, n] (current token's hidden row); caches: [B, max_S, n];
+        pos: [B] int32, the sequence position each row is writing.  The
+        new K/V row is scattered at `pos`, scores are [B, H, max_S] — one
+        sequence-scaled axis, never [S, S] — and key positions > pos get
+        the same additive -1e30 mask as `nd.attention.full_attention`,
+        so a greedy decode reproduces the eager full-forward trajectory
+        exactly in f32.
+        """
+        b, n = x.shape
+        h = conf.n_heads
+        hd = n // h
+        cd = compute_dtype(conf)
+        xn = _layer_norm(x, params["ln_g"], params["ln_b"])
+        qkv = mixed_matmul(xn, params["Wqkv"], conf) + params["bqkv"]
+        q, k, v = jnp.split(qkv.astype(cd), 3, axis=-1)
+        rows = jnp.arange(b)
+        k_cache = k_cache.at[rows, pos].set(k.astype(k_cache.dtype))
+        v_cache = v_cache.at[rows, pos].set(v.astype(v_cache.dtype))
+        max_s = k_cache.shape[1]
+        qh = q.reshape(b, h, hd)
+        kh = k_cache.astype(cd).reshape(b, max_s, h, hd)
+        vh = v_cache.astype(cd).reshape(b, max_s, h, hd)
+        s = jnp.einsum("bhd,bkhd->bhk", qh, kh) / jnp.sqrt(
+            jnp.asarray(hd, qh.dtype))
+        kpos = jnp.arange(max_s)[None, :]
+        mask = jnp.where(kpos <= pos[:, None], 0.0, -1e30).astype(s.dtype)
+        p = jax.nn.softmax(s + mask[:, None, :], axis=-1)
+        o = jnp.einsum("bhk,bkhd->bhd", p, vh)
+        o = mixed_matmul(o.reshape(b, n).astype(x.dtype),
+                         params["Wo"], conf) + params["bo"]
+        return x + o, k_cache, v_cache
 
 
 def _layer_norm(x, g, b, eps: float = 1e-5):

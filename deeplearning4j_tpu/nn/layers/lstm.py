@@ -126,6 +126,33 @@ class LSTMLayer:
         (h, c), _ = LSTMLayer._step(params, conf.n_out, (h, c), x_t)
         return h, c
 
+    @classmethod
+    def prefill(cls, params, conf, x, h0, c0, length):
+        """Prompt phase of cached generation: scan the prompt through the
+        per-step concat form (`cls._step`, the exact math `step()` runs
+        one token at a time — NOT the reassociated `_hoisted_scan`), so
+        the resulting carry is bitwise what repeated eager `step()` calls
+        produce.  Rows are frozen once `t >= length[row]` so bucket
+        padding never advances a carry.
+
+        x: [B, T, n_in]; length: [B] int32.  Returns
+        (hs [B, T, n_out], h [B, n_out], c [B, n_out]).
+        """
+        n_h = conf.n_out
+
+        def scan_step(carry, inp):
+            t, x_t = inp
+            (h2, c2), _ = cls._step(params, n_h, carry, x_t)
+            live = (t < length)[:, None]
+            h2 = jnp.where(live, h2, carry[0])
+            c2 = jnp.where(live, c2, carry[1])
+            return (h2, c2), h2
+
+        T = x.shape[1]
+        (h, c), hs = jax.lax.scan(
+            scan_step, (h0, c0), (jnp.arange(T), jnp.swapaxes(x, 0, 1)))
+        return jnp.swapaxes(hs, 0, 1), h, c
+
 
 class GravesLSTMLayer(LSTMLayer):
     """LSTM with peephole connections — what "Graves" means (Graves 2013,

@@ -526,13 +526,52 @@ class MultiLayerNetwork:
             "infer_cache": self.infer_cache.stats.as_dict(),
         }
 
+    def warmup_generate(self, slots: int = 4, max_seq: int = 64,
+                        prompt_buckets: Sequence[int] = (8,)):
+        """Precompile the autoregressive generation programs (ISSUE 14)
+        ahead of traffic: ONE decode step over the `slots`-wide table
+        plus one prefill program per prompt bucket (each admission
+        prefills a single row, so prefill compiles at B=1).  With a
+        persistent store attached the programs land on disk like every
+        other warmup — a restarted serve process starts generating with
+        `fresh_compiles == 0`.  Returns a summary with the cache stats."""
+        if self.params is None:
+            self.init()
+        ic = self.infer_cache
+        state = ic.init_decode_state(self.conf, slots, max_seq)
+        tok = jnp.zeros((slots,), jnp.int32)
+        pos = jnp.zeros((slots,), jnp.int32)
+        keys = jnp.zeros((slots, 2), jnp.uint32)
+        temps = jnp.zeros((slots,), jnp.float32)
+        ic.decode(self.conf, self.params, state, tok, pos, keys, temps,
+                  compile_only=True)
+        row = ic.init_decode_state(self.conf, 1, max_seq)
+        buckets = sorted(int(b) for b in prompt_buckets)
+        for tb in buckets:
+            if tb > max_seq:
+                raise ValueError(f"prompt bucket {tb} exceeds "
+                                 f"max_seq={max_seq}")
+            prompt = jnp.zeros((1, tb), jnp.int32)
+            length = jnp.ones((1,), jnp.int32)
+            ic.prefill(self.conf, self.params, row, prompt, length,
+                       keys[:1], temps[:1], compile_only=True)
+        return {
+            "slots": int(slots),
+            "max_seq": int(max_seq),
+            "prompt_buckets": buckets,
+            "infer_cache": ic.stats.as_dict(),
+        }
+
     # -- serving ------------------------------------------------------------
     def serve(self, host: str = "127.0.0.1", port: int = 0,
               max_delay_ms: float = 3.0, max_pending: int = 1024,
               max_batch_rows=None, batching: bool = True,
               request_timeout_s: float = 30.0,
               drain_timeout_s: float = 10.0,
-              default_deadline_ms=None, breaker=None):
+              default_deadline_ms=None, breaker=None,
+              generate: bool = False, gen_slots: int = 4,
+              gen_max_seq: int = 64, gen_prompt_buckets=(8,),
+              gen_max_pending: int = 64):
         """Start the micro-batching HTTP gateway over this network
         (`serving.ModelServer`): POST /v1/predict coalesces concurrent
         requests into one bucketed infer-cache call per flush, GET
@@ -540,8 +579,12 @@ class MultiLayerNetwork:
         percentiles / fresh-compile count / breaker state, GET
         /healthz + /readyz report liveness/readiness.  Call `warmup()`
         (or attach a warmed `set_compile_cache` dir) first so the first
-        request is served without a fresh compile.  Returns the started
-        server; `server.stop()` drains gracefully and shuts it down."""
+        request is served without a fresh compile.  `generate=True`
+        additionally runs the continuous-batching decode loop behind
+        POST /v1/generate (call `warmup_generate()` with matching
+        gen_* arguments first for the same zero-compile start).
+        Returns the started server; `server.stop()` drains gracefully
+        and shuts it down."""
         from deeplearning4j_tpu.serving.server import ModelServer
 
         if self.params is None:
@@ -554,7 +597,10 @@ class MultiLayerNetwork:
                            request_timeout_s=request_timeout_s,
                            drain_timeout_s=drain_timeout_s,
                            default_deadline_ms=default_deadline_ms,
-                           breaker=breaker).start()
+                           breaker=breaker, generate=generate,
+                           gen_slots=gen_slots, gen_max_seq=gen_max_seq,
+                           gen_prompt_buckets=gen_prompt_buckets,
+                           gen_max_pending=gen_max_pending).start()
 
     # -- inference ---------------------------------------------------------
     def _serve_cached(self, x) -> bool:

@@ -322,6 +322,70 @@ class InferCache(CompiledProgramCache):
         return [truncate_rows(a, bucket, n)
                 for a in fn(*self._place(sp, xp))]
 
+    # -- autoregressive generation (ISSUE 14) --------------------------------
+    def _decode_donate(self) -> Tuple[int, ...]:
+        """Decode-entry donation: the state tuple (arg 1) is consumed
+        every step — its K/V caches and LSTM carries keep their shapes
+        and dtypes, so jit aliases them in place instead of allocating a
+        fresh [B, max_S, n] table per token.  Params (arg 0) are NEVER
+        donated (shared with every other serve call).  CPU skips
+        donation like the train cache does (buffer donation is a no-op
+        warning there)."""
+        from deeplearning4j_tpu.nd.platform import default_backend
+
+        return (1,) if default_backend() != "cpu" else ()
+
+    def init_decode_state(self, conf, batch: int, max_seq: int):
+        """Fresh decode state shaped for the active policy's programs."""
+        from deeplearning4j_tpu.nn import decode as decode_mod
+
+        return decode_mod.init_state(_policy_conf(conf, self._policy),
+                                     batch, max_seq)
+
+    def decode(self, conf, params, state, tok, pos, keys, temps,
+               compile_only: bool = False):
+        """One compiled KV-cache decode step over the whole slot table:
+        tok/pos [B] int32, keys [B, 2] uint32 per-row PRNG keys, temps
+        [B] f32 (<= 0 rows decode greedily).  Returns (next_tok [B]
+        int32, advanced keys, new state); the state argument is donated
+        off-CPU.  Generation is single-chip — the key carries the SINGLE
+        tag regardless of any serve mesh."""
+        policy, sp = self._policy, self._serve_params(params)
+        key = ("decode", self._fingerprint(conf),
+               arg_signature(tok, pos, keys, temps,
+                             *jax.tree_util.tree_leaves(state)),
+               self.SINGLE) + self._policy_suffix()
+        fn = self._get(key, lambda: _decode_program(conf, policy),
+                       (sp, state, tok, pos, keys, temps),
+                       donate=self._decode_donate())
+        if compile_only:
+            return None
+        with self._lock:
+            self.stats.steps += 1
+        return fn(sp, state, tok, pos, keys, temps)
+
+    def prefill(self, conf, params, state, prompt, length, keys, temps,
+                compile_only: bool = False):
+        """Compiled prompt prefill: prompt [B, T_bucket] int32
+        (zero-padded), length [B] int32.  Fills the decode state and
+        samples each row's FIRST generated token (time-to-first-token is
+        one program execution).  Same donation/key contract as
+        `decode`; one program per (fingerprint, rows, prompt bucket,
+        max_seq) via the state leaves in the signature."""
+        policy, sp = self._policy, self._serve_params(params)
+        key = ("prefill", self._fingerprint(conf),
+               arg_signature(prompt, length, keys, temps,
+                             *jax.tree_util.tree_leaves(state)),
+               self.SINGLE) + self._policy_suffix()
+        fn = self._get(key, lambda: _prefill_program(conf, policy),
+                       (sp, state, prompt, length, keys, temps),
+                       donate=self._decode_donate())
+        if compile_only:
+            return None
+        with self._lock:
+            self.stats.steps += 1
+        return fn(sp, state, prompt, length, keys, temps)
+
     def loss(self, conf, params, x, y, compile_only: bool = False):
         """`network_loss(training=False)` through the cache: the
         row-weighted mean loss over the real rows plus regularization.
@@ -360,6 +424,53 @@ def _policy_args(params, policy: str):
     from deeplearning4j_tpu.optimize.quantize import runtime_params
 
     return runtime_params(params, policy)
+
+
+def _sample_tokens(logp, keys, temps):
+    """On-device sampling with the eager sampler's exact PRNG
+    discipline: every row splits its key once per step (`key, sub =
+    split(key)`), rows with temperature <= 0 take argmax, the rest draw
+    `categorical(sub, logp / temperature)`.  Returns (tok [B] int32,
+    advanced keys [B, 2])."""
+    ks = jax.vmap(jax.random.split)(keys)          # [B, 2, 2]
+    new_keys, subs = ks[:, 0], ks[:, 1]
+    greedy = jnp.argmax(logp, axis=-1).astype(jnp.int32)
+    safe = jnp.where(temps > 0, temps, jnp.ones_like(temps))
+    sampled = jax.vmap(jax.random.categorical)(
+        subs, logp / safe[:, None]).astype(jnp.int32)
+    return jnp.where(temps > 0, sampled, greedy), new_keys
+
+
+def _decode_program(conf, policy: str = "f32") -> Callable:
+    from deeplearning4j_tpu.nn import decode as decode_mod
+
+    pconf = _policy_conf(conf, policy)
+
+    def program(params, state, tok, pos, keys, temps):
+        logp, state = decode_mod.decode_step(
+            pconf, _policy_args(params, policy), state, tok, pos)
+        if policy != "f32":
+            logp = logp.astype(jnp.float32)
+        tok2, keys2 = _sample_tokens(logp, keys, temps)
+        return tok2, keys2, state
+
+    return program
+
+
+def _prefill_program(conf, policy: str = "f32") -> Callable:
+    from deeplearning4j_tpu.nn import decode as decode_mod
+
+    pconf = _policy_conf(conf, policy)
+
+    def program(params, state, prompt, length, keys, temps):
+        logp, state = decode_mod.prefill(
+            pconf, _policy_args(params, policy), state, prompt, length)
+        if policy != "f32":
+            logp = logp.astype(jnp.float32)
+        tok0, keys2 = _sample_tokens(logp, keys, temps)
+        return tok0, keys2, state
+
+    return program
 
 
 def _output_program(conf, policy: str = "f32") -> Callable:

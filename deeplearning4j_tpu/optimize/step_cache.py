@@ -219,7 +219,8 @@ class CompiledProgramCache:
             return list(self._audit_records.values())
 
     def _get(self, key: Tuple, build: Callable[[], Callable], args: Tuple,
-             shardings: Optional[Tuple] = None):
+             shardings: Optional[Tuple] = None,
+             donate: Optional[Tuple[int, ...]] = None):
         """Return the compiled executable for `key`: memory hit, else
         disk hit (persistent store attached), else a timed fresh
         trace+compile with disk write-back.  Serialized under the cache
@@ -230,12 +231,19 @@ class CompiledProgramCache:
         default single-device placement).  Each entry is applied to every
         leaf of the matching arg subtree, so a mesh-sharded program
         (replicated params, row-sharded batch) compiles with jit-inserted
-        collectives — the caller must fold the sharding into `key`."""
+        collectives — the caller must fold the sharding into `key`.
+
+        donate: optional per-program donate_argnums override (None =
+        the cache-wide `_donate_argnums()` policy).  Lets an entry with
+        a different aliasing contract — e.g. the KV-cache decode step,
+        which donates its state buffers but never its params — coexist
+        with the cache's default entries."""
         with self._lock:
-            return self._get_locked(key, build, args, shardings)
+            return self._get_locked(key, build, args, shardings, donate)
 
     def _get_locked(self, key: Tuple, build: Callable[[], Callable],
-                    args: Tuple, shardings: Optional[Tuple] = None):
+                    args: Tuple, shardings: Optional[Tuple] = None,
+                    donate: Optional[Tuple[int, ...]] = None):
         fn = self._programs.get(key)
         if fn is not None:
             self.stats.hits += 1
@@ -251,7 +259,7 @@ class CompiledProgramCache:
                         jnp.shape(a), jnp.asarray(a).dtype, sharding=_s),
                     arg)
                 for arg, s in zip(args, shardings))
-        donate = self._donate_argnums()
+        donate = self._donate_argnums() if donate is None else tuple(donate)
         self._audit_records[key] = {
             "key": key, "kind": self.kind, "build": build,
             "abstract": abstract, "donate_argnums": donate,

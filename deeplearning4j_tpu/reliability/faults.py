@@ -42,6 +42,17 @@ Injection points wired into the codebase:
                           an armed raise makes the respawn fail, which
                           is what drives the crash-loop quarantine
                           tests
+  ``generate.admit``      per stream admission into a free decode slot
+                          in `ContinuousBatcher` (serving/batcher.py):
+                          an armed raise fails ONE stream's prefill —
+                          the chaos tests prove the other slots keep
+                          decoding and the failed stream gets a clean
+                          5xx
+  ``decode.step``         per active slot per decode-table step in
+                          `ContinuousBatcher` (serving/batcher.py) —
+                          a mid-generation fault ends that slot's
+                          stream with an error while its neighbours
+                          finish their tokens
 
 The registry is generic — tests may `fire()` arbitrary point names of
 their own.  With nothing armed, `fire()` is a counter bump under a lock:
@@ -97,6 +108,10 @@ DOCUMENTED_POINTS = {
     "router.poll": "per replica health poll (serving/router.py)",
     "supervisor.spawn": "per replica (re)spawn attempt in FleetSupervisor "
                         "(serving/supervisor.py)",
+    "generate.admit": "per stream admission into a free decode slot in "
+                      "ContinuousBatcher (serving/batcher.py)",
+    "decode.step": "per active slot per decode-table step in "
+                   "ContinuousBatcher (serving/batcher.py)",
 }
 
 _PLAN_RE = re.compile(

@@ -40,10 +40,11 @@ Resilience (ISSUE 5):
 
 from __future__ import annotations
 
+import queue
 import threading
 import time
 from collections import deque
-from typing import Deque, Dict, Optional, Tuple
+from typing import Deque, Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -494,3 +495,372 @@ class MicroBatcher:
             "breaker": breaker,
             "priorities": priorities,
         }
+
+
+# -- continuous batching for autoregressive generation (ISSUE 14) -----------
+
+class GenerationStream:
+    """One in-flight generation request: its prompt, sampling knobs, and
+    the token queue the HTTP handler (or any caller thread) drains while
+    the decode loop keeps producing.
+
+    `tokens()` yields ints as they are generated and raises the stream's
+    stored error — after delivering every token that preceded it — when
+    generation failed mid-stream."""
+
+    def __init__(self, prompt, max_new_tokens: int, temperature: float,
+                 rng_seed: int):
+        import jax
+
+        self.prompt = np.asarray(prompt, np.int32).reshape(-1)
+        self.max_new = int(max_new_tokens)
+        self.temperature = float(temperature)
+        # per-stream PRNG key, split once per sampled token on-device —
+        # the eager sampler's exact key discipline
+        self.key = np.asarray(jax.random.PRNGKey(int(rng_seed)))
+        self.error: Optional[BaseException] = None
+        self.tokens_emitted = 0
+        self.t_submit = time.monotonic()
+        self.t_first: Optional[float] = None
+        self.t_done: Optional[float] = None
+        self._q: "queue.Queue" = queue.Queue()
+
+    # decode-loop side ------------------------------------------------------
+    def _emit(self, tok: int, now: float) -> None:
+        if self.t_first is None:
+            self.t_first = now
+        self.tokens_emitted += 1
+        self._q.put(int(tok))
+
+    def _finish(self, error: Optional[BaseException] = None) -> None:
+        self.error = error
+        self.t_done = time.monotonic()
+        self._q.put(None)
+
+    # consumer side ---------------------------------------------------------
+    def tokens(self, timeout: Optional[float] = None):
+        """Yield generated token ids until the stream completes; raises
+        the stored error (mid-generation fault) or TimeoutError when no
+        token arrives within `timeout` seconds."""
+        while True:
+            try:
+                t = self._q.get(timeout=timeout)
+            except queue.Empty:
+                raise TimeoutError(
+                    f"no token within {timeout}s (stream has "
+                    f"{self.tokens_emitted} so far)")
+            if t is None:
+                if self.error is not None:
+                    raise self.error
+                return
+            yield t
+
+    @property
+    def ttft_s(self) -> Optional[float]:
+        if self.t_first is None:
+            return None
+        return self.t_first - self.t_submit
+
+
+class ContinuousBatcher:
+    """Fixed-width decode slot table with per-step admission (Orca-style
+    continuous batching).
+
+    Every table step is ONE compiled `InferCache.decode` call over all
+    `n_slots` rows; a sequence that finishes frees its slot and the next
+    queued stream is admitted — prefilled and emitting its first token —
+    on the very next step instead of waiting for the longest neighbour
+    to finish.  `continuous=False` is the sequential control arm
+    (`bench_generate`): admission only happens when EVERY slot is free,
+    so each wave barriers on its longest sequence.
+
+    Correctness: rows are independent (each slot carries its own K/V
+    table and LSTM state and its own PRNG key), so slot packing never
+    changes a stream's tokens — a greedy stream reproduces the eager
+    sampler's trajectory exactly regardless of its neighbours.
+    """
+
+    def __init__(self, net, n_slots: int = 4, max_seq: int = 64,
+                 prompt_buckets: Tuple[int, ...] = (8,),
+                 max_pending: int = 64, continuous: bool = True,
+                 auto_start: bool = True):
+        self.net = net
+        self.n_slots = int(n_slots)
+        self.max_seq = int(max_seq)
+        self.prompt_buckets = tuple(sorted(
+            int(b) for b in prompt_buckets if int(b) <= self.max_seq))
+        self.max_pending = int(max_pending)
+        self.continuous = bool(continuous)
+        self._auto_start = auto_start
+        self._cv = threading.Condition()
+        self._pending: Deque[GenerationStream] = deque()
+        self._stop = False
+        self._thread: Optional[threading.Thread] = None
+        # -- slot table (decode-loop thread only) --------------------------
+        self._state = None                      # device tree, B = n_slots
+        self._slots: List[Optional[GenerationStream]] = [None] * self.n_slots
+        self._tok = np.zeros((self.n_slots,), np.int32)
+        self._pos = np.zeros((self.n_slots,), np.int32)
+        self._keys = np.zeros((self.n_slots, 2), np.uint32)
+        self._temps = np.zeros((self.n_slots,), np.float32)
+        # -- stats (guarded by _cv's lock) ---------------------------------
+        self._t_start = time.monotonic()
+        self._tokens_total = 0
+        self._admitted = 0
+        self._completed = 0
+        self._failed = 0
+        self._active = 0
+        self._recent_tokens: Deque[Tuple[float, int]] = deque()
+        self._ttfts: Deque[float] = deque(maxlen=4096)
+        self._ttft_hist = {"counts": [0] * len(LATENCY_BUCKETS_S),
+                           "inf": 0, "sum": 0.0, "count": 0}
+
+    # -- lifecycle ----------------------------------------------------------
+    def start(self) -> "ContinuousBatcher":
+        with self._cv:
+            if self._thread is not None:
+                return self
+            self._stop = False
+            if self._state is None:
+                self._state = self.net.infer_cache.init_decode_state(
+                    self.net.conf, self.n_slots, self.max_seq)
+            self._thread = threading.Thread(
+                target=self._decode_loop, name="dl4j-decode", daemon=True)
+            self._thread.start()
+        return self
+
+    def stop(self, timeout: float = 30.0) -> None:
+        """Stop the decode loop; queued and in-flight streams are run to
+        completion first (drain = serve, like the MicroBatcher)."""
+        with self._cv:
+            self._stop = True
+            thread, self._thread = self._thread, None
+            self._cv.notify_all()
+        if thread is not None:
+            thread.join(timeout=timeout)
+
+    # -- request side (any thread) ------------------------------------------
+    def submit(self, prompt, max_new_tokens: int = 16,
+               temperature: float = 0.0,
+               rng_seed: int = 0) -> GenerationStream:
+        """Queue a generation request; returns its `GenerationStream`
+        immediately (tokens arrive on `stream.tokens()`).  Greedy when
+        `temperature <= 0`.  Raises `ServerOverloaded` past
+        `max_pending` queued streams and ValueError for prompts the
+        decode table cannot hold."""
+        stream = GenerationStream(prompt, max_new_tokens, temperature,
+                                  rng_seed)
+        n = int(stream.prompt.shape[0])
+        if n < 1:
+            raise ValueError("prompt must hold at least one token id")
+        if n >= self.max_seq:
+            raise ValueError(
+                f"prompt of {n} tokens leaves no room to generate in a "
+                f"max_seq={self.max_seq} decode table")
+        if stream.max_new < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        # the table edge bounds the stream, never overruns it
+        stream.max_new = min(stream.max_new, self.max_seq - n)
+        with self._cv:
+            if self._stop and self._thread is None:
+                raise ServerOverloaded("generation batcher is stopped")
+            if len(self._pending) >= self.max_pending:
+                raise ServerOverloaded(
+                    f"{len(self._pending)} generation streams already "
+                    f"pending (max_pending={self.max_pending})")
+            self._pending.append(stream)
+            self._cv.notify_all()
+        if self._thread is None and self._auto_start:
+            self.start()
+        return stream
+
+    def generate(self, prompt, max_new_tokens: int = 16,
+                 temperature: float = 0.0, rng_seed: int = 0,
+                 timeout: Optional[float] = 60.0) -> List[int]:
+        """Blocking convenience: submit + drain the whole stream."""
+        stream = self.submit(prompt, max_new_tokens, temperature, rng_seed)
+        return list(stream.tokens(timeout=timeout))
+
+    # -- decode loop (one thread) -------------------------------------------
+    def _prompt_bucket(self, n: int) -> int:
+        for b in self.prompt_buckets:
+            if b >= n:
+                return b
+        return n  # oversize prompt: its own bucket (fresh compile, logged)
+
+    def _free_slots(self) -> List[int]:
+        return [i for i, s in enumerate(self._slots) if s is None]
+
+    def _admit_one(self, slot: int, stream: GenerationStream) -> None:
+        """Prefill `stream` into `slot`: one B=1 prefill program fills a
+        row state and samples the stream's first token (TTFT = this
+        call), then the row is scattered into the slot table."""
+        import jax
+
+        ic = self.net.infer_cache
+        faults.fire("generate.admit", slot=slot,
+                    prompt_tokens=int(stream.prompt.shape[0]))
+        n = int(stream.prompt.shape[0])
+        bucket = self._prompt_bucket(n)
+        prompt = np.zeros((1, bucket), np.int32)
+        prompt[0, :n] = stream.prompt
+        length = np.asarray([n], np.int32)
+        temps = np.asarray([stream.temperature], np.float32)
+        row = ic.init_decode_state(self.net.conf, 1, self.max_seq)
+        tok0, keys1, row = ic.prefill(self.net.conf, self.net.params, row,
+                                      prompt, length, stream.key[None],
+                                      temps)
+        self._state = jax.tree_util.tree_map(
+            lambda tbl, r: tbl.at[slot].set(r[0]), self._state, row)
+        self._slots[slot] = stream
+        self._tok[slot] = int(tok0[0])
+        self._pos[slot] = n
+        self._keys[slot] = np.asarray(keys1[0])
+        self._temps[slot] = stream.temperature
+        now = time.monotonic()
+        stream._emit(int(tok0[0]), now)
+        with self._cv:
+            self._admitted += 1
+            self._active += 1
+            self._tokens_total += 1
+            self._recent_tokens.append((now, 1))
+            ttft = stream.ttft_s
+            self._ttfts.append(ttft)
+            h = self._ttft_hist
+            h["sum"] += ttft
+            h["count"] += 1
+            for i, bound in enumerate(LATENCY_BUCKETS_S):
+                if ttft <= bound:
+                    h["counts"][i] += 1
+                    break
+            else:
+                h["inf"] += 1
+        if stream.tokens_emitted >= stream.max_new:
+            self._release_slot(slot, stream)
+
+    def _release_slot(self, slot: int,
+                      stream: GenerationStream,
+                      error: Optional[BaseException] = None) -> None:
+        stream._finish(error)
+        self._slots[slot] = None
+        self._temps[slot] = 0.0
+        with self._cv:
+            self._active -= 1
+            if error is None:
+                self._completed += 1
+            else:
+                self._failed += 1
+            self._cv.notify_all()
+
+    def _admit_pending(self) -> None:
+        free = self._free_slots()
+        if not self.continuous and len(free) != self.n_slots:
+            return  # sequential arm: barrier on the slowest slot
+        for slot in free:
+            with self._cv:
+                if not self._pending:
+                    return
+                stream = self._pending.popleft()
+            try:
+                self._admit_one(slot, stream)
+            except BaseException as e:  # noqa: BLE001 — isolate the stream
+                with self._cv:
+                    self._failed += 1
+                stream._finish(e)
+
+    def _decode_once(self) -> None:
+        """One table step: fire per-slot fault points (a raise ends THAT
+        stream only), then one compiled decode call over all slots, then
+        emit per-slot tokens and free finished slots."""
+        for slot, stream in enumerate(self._slots):
+            if stream is None:
+                continue
+            try:
+                faults.fire("decode.step", slot=slot,
+                            pos=int(self._pos[slot]))
+            except BaseException as e:  # noqa: BLE001 — isolate the stream
+                self._release_slot(slot, stream, error=e)
+        if not any(s is not None for s in self._slots):
+            return
+        ic = self.net.infer_cache
+        tok2, keys2, self._state = ic.decode(
+            self.net.conf, self.net.params, self._state,
+            self._tok.copy(), self._pos.copy(), self._keys.copy(),
+            self._temps.copy())
+        tok2 = np.asarray(tok2)
+        keys2 = np.asarray(keys2)
+        now = time.monotonic()
+        emitted = 0
+        for slot, stream in enumerate(self._slots):
+            if stream is None:
+                continue
+            self._tok[slot] = tok2[slot]
+            self._pos[slot] += 1
+            self._keys[slot] = keys2[slot]
+            stream._emit(int(tok2[slot]), now)
+            emitted += 1
+            if (stream.tokens_emitted >= stream.max_new
+                    or int(self._pos[slot]) >= self.max_seq):
+                self._release_slot(slot, stream)
+        with self._cv:
+            self._tokens_total += emitted
+            self._recent_tokens.append((now, emitted))
+            while (self._recent_tokens
+                   and now - self._recent_tokens[0][0] > RATE_WINDOW_S):
+                self._recent_tokens.popleft()
+
+    def _decode_loop(self) -> None:
+        while True:
+            self._admit_pending()
+            if any(s is not None for s in self._slots):
+                self._decode_once()
+                continue
+            with self._cv:
+                if self._pending:
+                    continue
+                if self._stop:
+                    return
+                self._cv.wait(timeout=0.5)
+
+    # -- observability -------------------------------------------------------
+    def stats(self) -> dict:
+        """Generation counters for `/v1/stats`: slot occupancy, queue
+        depth, tokens/sec over the trailing window, TTFT percentiles +
+        histogram, stream outcomes, and the fresh-compile count."""
+        with self._cv:
+            now = time.monotonic()
+            recent = sum(c for t, c in self._recent_tokens
+                         if now - t <= RATE_WINDOW_S)
+            ttfts = sorted(self._ttfts)
+            h = self._ttft_hist
+            active = self._active
+            out = {
+                "slots": {"width": self.n_slots, "active": active,
+                          "free": self.n_slots - active},
+                "max_seq": self.max_seq,
+                "prompt_buckets": list(self.prompt_buckets),
+                "continuous": self.continuous,
+                "queue_depth": len(self._pending),
+                "streams": {"admitted": self._admitted,
+                            "completed": self._completed,
+                            "failed": self._failed},
+                "tokens": self._tokens_total,
+                "tokens_per_sec": round(
+                    recent / min(max(now - self._t_start, 1e-9),
+                                 RATE_WINDOW_S), 2),
+                "ttft_ms": {
+                    "p50": round(MicroBatcher._percentile(ttfts, 0.50) * 1e3,
+                                 3),
+                    "p99": round(MicroBatcher._percentile(ttfts, 0.99) * 1e3,
+                                 3),
+                },
+                "ttft_hist_s": {
+                    "bounds": list(LATENCY_BUCKETS_S),
+                    "counts": list(h["counts"]),
+                    "inf": h["inf"],
+                    "sum": h["sum"],
+                    "count": h["count"],
+                },
+            }
+        out["fresh_compiles"] = self.net.infer_cache.stats.misses
+        return out

@@ -63,6 +63,9 @@ FAMILIES = {
     "dl4j_serving_cache_misses_total": ("counter", ("policy",)),
     "dl4j_serving_cache_disk_hits_total": ("counter", ("policy",)),
     "dl4j_serving_cache_io_errors_total": ("counter", ("policy",)),
+    "dl4j_serving_tokens_total": ("counter", ()),
+    "dl4j_serving_ttft_seconds": ("histogram", ()),
+    "dl4j_serving_decode_slots": ("gauge", ("state",)),
     "dl4j_router_ready": ("gauge", ()),
     "dl4j_router_inflight": ("gauge", ()),
     "dl4j_router_replicas_healthy": ("gauge", ()),
@@ -280,6 +283,25 @@ def replica_metrics(stats: dict, page: Optional[PrometheusText] = None,
     p.counter("dl4j_serving_cache_io_errors_total",
               "Disk-cache I/O errors downgraded to misses.",
               cache.get("io_errors", 0), lbl(policy=policy))
+    gen = stats.get("generation")
+    if gen:
+        p.counter("dl4j_serving_tokens_total",
+                  "Tokens produced by the continuous-batching decode "
+                  "loop (prefill's first token included).",
+                  gen.get("tokens", 0), lbl())
+        h = gen.get("ttft_hist_s")
+        if h:
+            p.histogram("dl4j_serving_ttft_seconds",
+                        "Submit-to-first-token latency of generation "
+                        "streams.", h["bounds"], h["counts"], h["inf"],
+                        h["sum"], h["count"], lbl())
+        slots = gen.get("slots", {})
+        p.gauge("dl4j_serving_decode_slots",
+                "Decode slot-table occupancy (by state).",
+                slots.get("active", 0), lbl(state="active"))
+        p.gauge("dl4j_serving_decode_slots",
+                "Decode slot-table occupancy (by state).",
+                slots.get("free", 0), lbl(state="free"))
     return p.render() if own_page else ""
 
 

@@ -8,6 +8,17 @@
                      out `request_timeout_s` or its own `deadline_ms`;
                      "interactive" — the default — preempts queued
                      "batch" work in the coalescing queue)
+  POST /v1/generate  {"prompt": [ids...], "max_new_tokens": 16?,
+                      "temperature": 0.0?, "rng_seed": 0?}
+                     -> 200 chunked stream of {"token": id} JSON lines
+                     ending with {"done": true, "tokens": n,
+                     "ttft_ms": ...} (generation servers only —
+                     `generate=True` / `serve --generate`).  Failures
+                     BEFORE the first token are ordinary JSON errors
+                     (400 bad prompt, 503 overloaded/draining, 500
+                     prefill fault); a mid-stream fault ends THIS
+                     stream with an {"error": ..., "done": true} line
+                     while other decode slots keep producing.
   GET  /v1/stats     gateway counters (queue depth, batch-size histogram,
                      p50/p95/p99 latency, rows/s, fresh-compile count,
                      deadline misses, breaker state, `degraded` flag) plus
@@ -46,7 +57,9 @@ from urllib.parse import urlparse
 import numpy as np
 
 from deeplearning4j_tpu.reliability import CircuitBreaker, DeadlineExceeded
-from deeplearning4j_tpu.serving.batcher import (PRIORITIES, MicroBatcher,
+from deeplearning4j_tpu.serving.batcher import (PRIORITIES,
+                                                ContinuousBatcher,
+                                                MicroBatcher,
                                                 ServerOverloaded)
 
 
@@ -94,7 +107,11 @@ class _ServeHandler(BaseHTTPRequestHandler):
             self._send({"error": "not found"}, 404)
 
     def do_POST(self):  # noqa: N802
-        if urlparse(self.path).path != "/v1/predict":
+        path = urlparse(self.path).path
+        if path == "/v1/generate":
+            self._do_generate()
+            return
+        if path != "/v1/predict":
             self._send({"error": "not found"}, 404)
             return
         ms = self.model_server
@@ -140,6 +157,89 @@ class _ServeHandler(BaseHTTPRequestHandler):
         finally:
             ms.exit_request()
 
+    def _chunk(self, obj) -> None:
+        """One chunked-transfer frame holding one JSON line."""
+        data = (json.dumps(obj) + "\n").encode()
+        self.wfile.write(f"{len(data):x}\r\n".encode() + data + b"\r\n")
+
+    def _do_generate(self) -> None:
+        """POST /v1/generate — per-token streaming over chunked HTTP.
+
+        The response status is decided by the FIRST token: any failure
+        before it (bad request, queue full, draining, a prefill fault)
+        is a clean JSON error with a real 4xx/5xx.  From the first
+        token on, the response is a 200 chunked stream of
+        {"token": id} lines; a mid-generation fault on THIS stream
+        terminates it with an {"error": ..., "done": true} line while
+        the other decode slots keep producing."""
+        ms = self.model_server
+        if ms.generator is None:
+            self._send({"error": "generation not enabled on this server "
+                                 "(start with generate=True / --generate)"},
+                       404)
+            return
+        if not ms.enter_request():
+            self._send({"error": "draining: server is shutting down"}, 503)
+            return
+        try:
+            try:
+                body = self._body()
+                prompt = [int(t) for t in body["prompt"]]
+                max_new = int(body.get("max_new_tokens", 16))
+                temperature = float(body.get("temperature", 0.0))
+                rng_seed = int(body.get("rng_seed", 0))
+            except (KeyError, ValueError, TypeError,
+                    json.JSONDecodeError) as e:
+                self._send({"error": f"bad request: {e}"}, 400)
+                return
+            try:
+                stream = ms.generate_stream(prompt, max_new_tokens=max_new,
+                                            temperature=temperature,
+                                            rng_seed=rng_seed)
+            except ValueError as e:
+                self._send({"error": f"bad request: {e}"}, 400)
+                return
+            except ServerOverloaded as e:
+                self._send({"error": f"overloaded: {e}"}, 503)
+                return
+            except ServerDraining as e:
+                self._send({"error": f"draining: {e}"}, 503)
+                return
+            it = stream.tokens(timeout=ms.request_timeout_s)
+            try:
+                first = next(it)
+            except StopIteration:
+                self._send({"error": "stream produced no tokens"}, 500)
+                return
+            except TimeoutError as e:
+                self._send({"error": f"timed out: {e}"}, 504)
+                return
+            except ServerOverloaded as e:
+                self._send({"error": f"overloaded: {e}"}, 503)
+                return
+            except Exception as e:  # noqa: BLE001 — injected/prefill fault
+                self._send({"error": f"generation failed: {e}"}, 500)
+                return
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Transfer-Encoding", "chunked")
+            self.end_headers()
+            try:
+                self._chunk({"token": first})
+                for tok in it:
+                    self._chunk({"token": tok})
+                ttft = stream.ttft_s
+                self._chunk({"done": True,
+                             "tokens": stream.tokens_emitted,
+                             "ttft_ms": (None if ttft is None
+                                         else round(ttft * 1e3, 3))})
+            except Exception as e:  # noqa: BLE001 — mid-stream fault
+                self._chunk({"error": f"generation failed: {e}",
+                             "done": True})
+            self.wfile.write(b"0\r\n\r\n")
+        finally:
+            ms.exit_request()
+
     def log_message(self, *args):  # quiet
         pass
 
@@ -162,7 +262,11 @@ class ModelServer:
                  request_timeout_s: float = 30.0,
                  drain_timeout_s: float = 10.0,
                  default_deadline_ms: Optional[float] = None,
-                 breaker: Optional[CircuitBreaker] = None):
+                 breaker: Optional[CircuitBreaker] = None,
+                 generate: bool = False, gen_slots: int = 4,
+                 gen_max_seq: int = 64,
+                 gen_prompt_buckets=(8,),
+                 gen_max_pending: int = 64):
         self.net = net
         self.batching = bool(batching)
         self.request_timeout_s = float(request_timeout_s)
@@ -172,6 +276,15 @@ class ModelServer:
             net, max_delay_ms=max_delay_ms, max_pending=max_pending,
             max_batch_rows=max_batch_rows, auto_start=False,
             breaker=breaker)
+        # POST /v1/generate rides its own continuous-batching decode
+        # loop (generate=True): a fixed slot table stepped by one
+        # compiled KV-cache program, streams admitted into freed slots
+        self.generator: Optional[ContinuousBatcher] = (
+            ContinuousBatcher(net, n_slots=gen_slots, max_seq=gen_max_seq,
+                              prompt_buckets=gen_prompt_buckets,
+                              max_pending=gen_max_pending,
+                              auto_start=False)
+            if generate else None)
         handler = type("Handler", (_ServeHandler,), {"model_server": self})
         self.server = ThreadingHTTPServer((host, port), handler)
         self.port = self.server.server_address[1]
@@ -220,6 +333,19 @@ class ModelServer:
                                         priority=priority)
         return np.asarray(self.net.output(feats))
 
+    def generate_stream(self, prompt, max_new_tokens: int = 16,
+                        temperature: float = 0.0, rng_seed: int = 0):
+        """Submit a generation request to the continuous batcher and
+        return its `GenerationStream` (tokens arrive as the decode loop
+        produces them)."""
+        if self.generator is None:
+            raise RuntimeError("generation not enabled (generate=True)")
+        if self.draining:
+            raise ServerDraining("server is draining")
+        return self.generator.submit(prompt, max_new_tokens=max_new_tokens,
+                                     temperature=temperature,
+                                     rng_seed=rng_seed)
+
     def stats(self) -> dict:
         out = self.batcher.stats()
         out["batching"] = self.batching
@@ -232,6 +358,10 @@ class ModelServer:
         # operators verify warmup coverage (did the warmed programs
         # carry the right bucket/sharding/policy?) from one scrape
         out["programs"] = self.net.infer_cache.programs_summary()
+        if self.generator is not None:
+            # tokens/sec, TTFT, slot occupancy — the generation-side
+            # half of the one-curl observability contract
+            out["generation"] = self.generator.stats()
         store = self.net.infer_cache.persist
         if store is not None:
             out["compile_cache_dir"] = store.directory
@@ -240,6 +370,8 @@ class ModelServer:
     # -- lifecycle ------------------------------------------------------------
     def start(self) -> "ModelServer":
         self.batcher.start()
+        if self.generator is not None:
+            self.generator.start()
         self._thread = threading.Thread(target=self.server.serve_forever,
                                         daemon=True)
         self._thread.start()
@@ -279,6 +411,11 @@ class ModelServer:
             time.sleep(0.005)
         # batcher drain-on-stop serves whatever the handlers enqueued
         self.batcher.stop(timeout=max(deadline - time.monotonic(), 1.0))
+        if self.generator is not None:
+            # in-flight generations run to completion (bounded by their
+            # max_seq tables), queued ones are served like predicts
+            self.generator.stop(timeout=max(deadline - time.monotonic(),
+                                            1.0))
         self.server.server_close()
 
     def stop(self) -> None:

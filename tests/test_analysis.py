@@ -159,6 +159,45 @@ def test_undonated_step_via_cache_records():
     assert audit_cache(FakeCache([rec]), expect_donation=False) == []
 
 
+def test_undonated_kv_cache_via_cache_records():
+    class FakeCache:
+        def __init__(self, recs):
+            self._recs = recs
+
+        def audit_records(self):
+            return list(self._recs)
+
+    aval = jax.ShapeDtypeStruct((2, 2), jnp.float32)
+    rec = {"key": ("decode", "fp", ((2,), "int32"), "single"),
+           "kind": "infer-cache",
+           "build": lambda: (lambda p, s: p + s),
+           "abstract": (aval, aval), "donate_argnums": (), "mesh": False}
+    fs = audit_cache(FakeCache([rec]), expect_donation=True)
+    assert _rules(fs) == ["undonated-kv-cache"]
+    # prefill entries are held to the same donation contract
+    fs = audit_cache(FakeCache([dict(rec, key=("prefill",) + rec["key"][1:])]),
+                     expect_donation=True)
+    assert _rules(fs) == ["undonated-kv-cache"]
+    # donated, not a decode entry, or donation not expected (CPU): clean
+    assert audit_cache(FakeCache([dict(rec, donate_argnums=(1,))]),
+                       expect_donation=True) == []
+    assert audit_cache(FakeCache([dict(rec, key=("output",)
+                                       + rec["key"][1:])]),
+                       expect_donation=True) == []
+    assert audit_cache(FakeCache([rec]), expect_donation=False) == []
+
+
+def test_decode_structure_audit_is_clean():
+    """The compiled decode step must stay [S,S]-free at a cache length
+    where a full-scores materialization is unambiguous (the ISSUE 14
+    correctness anchor: decode attends [B,1] queries against the cache,
+    so scores carry ONE sequence axis)."""
+    from deeplearning4j_tpu.analysis.program_audit import (
+        audit_decode_structure)
+
+    assert audit_decode_structure() == []
+
+
 def test_real_step_cache_keeps_audit_records():
     from deeplearning4j_tpu.models.zoo import lenet5
     from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork

@@ -290,6 +290,48 @@ def test_serve_cli_parser_and_builder(tmp_path):
         server.stop()
 
 
+def test_serve_generate_warmed_zero_fresh_compiles(tmp_path):
+    """ISSUE 14 satellite: `warmup --generate` prepays the decode +
+    prefill compiles into the persistent store; a fresh-process `serve
+    --generate` with the same gen_* flags starts from disk restores and
+    streams its first generation with fresh_compiles == 0."""
+    from deeplearning4j_tpu.cli.driver import _build_server, build_parser
+    from deeplearning4j_tpu.models.zoo import char_lstm
+    from deeplearning4j_tpu.parallel import checkpoint
+
+    cache_dir = str(tmp_path / "compile-cache")
+    conf = char_lstm(11, hidden=12, n_layers=1)
+    warm = MultiLayerNetwork(conf, seed=0).init()
+    ckpt = str(tmp_path / "ckpt")
+    checkpoint.save(ckpt, warm.params, conf=warm.conf)
+    warm.set_compile_cache(cache_dir)
+    warm.warmup_generate(slots=2, max_seq=16, prompt_buckets=(8,))
+    assert warm.infer_cache.stats.misses > 0  # the compiles we prepaid
+
+    args = build_parser().parse_args(
+        ["serve", "--model", ckpt, "--compile-cache", cache_dir,
+         "--shapes", "", "--generate", "--gen-slots", "2",
+         "--gen-max-seq", "16", "--gen-prompt-buckets", "8"])
+    srv_net, server, summary = _build_server(args)
+    try:
+        assert summary["fresh_compiles"] == 0, summary
+        assert summary["generation"]["prompt_buckets"] == [8], summary
+        req = urllib.request.Request(
+            server.url + "/v1/generate",
+            data=json.dumps({"prompt": [1, 2], "max_new_tokens": 4}
+                            ).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=30) as r:
+            lines = [json.loads(ln) for ln in
+                     r.read().decode().strip().splitlines()]
+        assert sum(1 for ln in lines if "token" in ln) == 4
+        _, stats = _http(server.url + "/v1/stats")
+        assert stats["generation"]["fresh_compiles"] == 0, stats
+        assert srv_net.infer_cache.stats.misses == 0
+    finally:
+        server.stop()
+
+
 # -- closed-loop load (CI satellite: slow, mirrors bench_serve) --------------
 
 @pytest.mark.slow

@@ -171,6 +171,47 @@ def test_metrics_content_type_and_histogram_shape():
     assert infs[0] == parsed["dl4j_serving_batch_rows_count"][()]
 
 
+def test_generation_metrics_conformance_and_monotonic(tmp_path):
+    """The ISSUE 14 families — tokens counter, TTFT histogram, decode
+    slot gauge — render to strictly-parseable text and the counters
+    only move up across scrapes with traffic in between."""
+    from deeplearning4j_tpu.models.zoo import char_lstm
+
+    net = MultiLayerNetwork(char_lstm(11, hidden=12, n_layers=1),
+                            seed=0).init()
+    net.warmup_generate(slots=2, max_seq=16, prompt_buckets=(8,))
+    server = net.serve(generate=True, gen_slots=2, gen_max_seq=16,
+                       gen_prompt_buckets=(8,))
+    try:
+        _http(server.url + "/v1/generate",
+              {"prompt": [1, 2], "max_new_tokens": 4})
+        code, text1 = _http(server.url + "/metrics")
+        assert code == 200
+        parsed1 = parse_prometheus_text(text1)  # raises on any bad line
+        for family in ("dl4j_serving_tokens_total",
+                       "dl4j_serving_ttft_seconds_bucket",
+                       "dl4j_serving_ttft_seconds_count",
+                       "dl4j_serving_decode_slots"):
+            assert family in parsed1, family
+        # the slot gauge carries the state label, both states present
+        states = {dict(lbl).get("state")
+                  for lbl in parsed1["dl4j_serving_decode_slots"]}
+        assert states == {"active", "free"}
+        # one completed 4-token stream is on the counter and histogram
+        assert list(parsed1["dl4j_serving_tokens_total"].values())[0] >= 4
+        assert list(
+            parsed1["dl4j_serving_ttft_seconds_count"].values())[0] >= 1
+        _http(server.url + "/v1/generate",
+              {"prompt": [3], "max_new_tokens": 3})
+        code, text2 = _http(server.url + "/metrics")
+        parsed2 = parse_prometheus_text(text2)
+        _assert_monotonic(parsed1, parsed2)
+        assert (list(parsed2["dl4j_serving_tokens_total"].values())[0]
+                > list(parsed1["dl4j_serving_tokens_total"].values())[0])
+    finally:
+        server.stop()
+
+
 def test_parser_rejects_malformed_lines():
     with pytest.raises(ValueError):
         parse_prometheus_text("this is not a metric line\n")
