@@ -1756,6 +1756,216 @@ def bench_generate(devs) -> None:
                         "on the identical arrival schedule")
 
 
+def bench_generate_accel(devs) -> None:
+    """The three ISSUE-16 decode accelerators, each against its own
+    off-switch on identical work: (a) paged KV vs dense slabs under the
+    SAME KV token budget — the paged pool admits more concurrent streams
+    because short streams only hold the pages they touched; (b) prefix
+    cache on vs off on a repeated long prompt — a hit skips the prefill
+    program entirely, so TTFT collapses; (c) speculative decoding on vs
+    off with a draft finetuned alongside the target on a cyclic corpus —
+    agreeing drafts land > 1 accepted token per verify step.  All three
+    arms are greedy and token-parity-checked in tests/test_generate.py;
+    here we only measure.  CPU-bound by design, like bench_generate."""
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.models.zoo import char_lstm
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.serving.batcher import ContinuousBatcher
+
+    # ---- (a) paged vs dense under one KV token budget --------------------
+    vocab, hidden = 24, 32
+    slots_dense, max_seq, page_size = (2, 16, 4) if SMALL else (4, 32, 4)
+    budget_tokens = slots_dense * max_seq          # what dense reserves
+    n_pages = budget_tokens // page_size           # same budget, paged
+    slots_paged = slots_dense * 2                  # overcommit the table
+    n_streams = 8 if SMALL else 16
+    out_lo, out_hi = 4, max(5, max_seq // 4)       # short streams: the
+    # overcommit case — nobody ever grows near max_seq, so dense slabs
+    # reserve ~4x what the workload touches
+
+    net = MultiLayerNetwork(char_lstm(vocab, hidden=hidden, n_layers=1),
+                            seed=0).init()
+    net.warmup_generate(slots=slots_dense, max_seq=max_seq,
+                        prompt_buckets=(8,))
+    net.warmup_generate(slots=slots_paged, max_seq=max_seq,
+                        prompt_buckets=(8,), page_size=page_size,
+                        n_pages=n_pages)
+
+    arr = np.random.RandomState(0)
+    prompts = [[int(t) for t in arr.randint(1, vocab, arr.randint(2, 7))]
+               for _ in range(n_streams)]
+    n_new = [int(arr.randint(out_lo, out_hi + 1)) for _ in range(n_streams)]
+
+    def run_pool(paged: bool):
+        cb = ContinuousBatcher(
+            net, n_slots=slots_paged if paged else slots_dense,
+            max_seq=max_seq, prompt_buckets=(8,),
+            max_pending=n_streams + 1,
+            page_size=page_size if paged else 0,
+            n_pages=n_pages if paged else 0)
+        peak = {"active": 0, "live_tokens": 0}
+        stop_poll = threading.Event()
+
+        def poll():
+            while not stop_poll.is_set():
+                st = cb.stats()
+                sts = st["streams"]
+                active = (sts["admitted"] - sts["completed"]
+                          - sts["failed"])
+                peak["active"] = max(peak["active"], active)
+                kv = st.get("kv_pages")
+                if kv:
+                    peak["live_tokens"] = max(peak["live_tokens"],
+                                              kv["live_tokens"])
+                time.sleep(0.002)
+
+        poller = threading.Thread(target=poll, daemon=True)
+        poller.start()
+        t0 = time.perf_counter()
+        try:
+            streams = [cb.submit(p, max_new_tokens=k)
+                       for p, k in zip(prompts, n_new)]
+            toks = sum(len(list(s.tokens(timeout=120.0)))
+                       for s in streams)
+            dt = time.perf_counter() - t0
+        finally:
+            stop_poll.set()
+            poller.join(timeout=5.0)
+            cb.stop()
+        # dense slabs hold max_seq tokens per occupied slot whether the
+        # stream uses them or not; the paged pool only holds live pages
+        reserved = (peak["live_tokens"] if paged
+                    else peak["active"] * max_seq)
+        return toks / max(dt, 1e-9), peak["active"], reserved
+
+    dense_tps, dense_peak, dense_tokens = run_pool(False)
+    paged_tps, paged_peak, paged_tokens = run_pool(True)
+    _emit("generate paged-KV admitted slots (same budget)", paged_peak,
+          "slots", paged_peak / max(dense_peak, 1),
+          dense_peak_slots=dense_peak,
+          kv_budget_tokens=budget_tokens, page_size=page_size,
+          dense_peak_reserved_tokens=dense_tokens,
+          paged_peak_live_tokens=paged_tokens,
+          paged_tokens_per_sec=round(paged_tps, 1),
+          dense_tokens_per_sec=round(dense_tps, 1),
+          baseline_note="same KV token budget; paged overcommits the "
+                        "slot table and short streams only pin the "
+                        "pages they touched")
+
+    # ---- (b) prefix cache on/off: repeated long prompt TTFT --------------
+    # a model where prefill actually costs something: the hit skips that
+    # whole program, so the deeper the net and the longer the prompt, the
+    # wider the gap (the hit pays only the admission + first-step floor)
+    bucket = 128 if SMALL else 256
+    long_prompt = [int(t) for t in arr.randint(1, vocab, bucket - 16)]
+    reps = 6 if SMALL else 10
+    pnet = MultiLayerNetwork(char_lstm(vocab, hidden=192, n_layers=2),
+                             seed=0).init()
+    pnet.warmup_generate(slots=2, max_seq=bucket + 16,
+                         prompt_buckets=(bucket,), prefix_cache=True)
+
+    def run_prefix(on: bool):
+        cb = ContinuousBatcher(pnet, n_slots=2, max_seq=bucket + 16,
+                               prompt_buckets=(bucket,),
+                               prefix_cache=on)
+        ttfts = []
+        try:
+            for _ in range(reps):
+                stream = cb.submit(long_prompt, max_new_tokens=2)
+                list(stream.tokens(timeout=60.0))
+                ttfts.append(stream.ttft_s * 1e3)
+        finally:
+            cb.stop()
+        # with the cache on, request 0 is the one cold miss that fills
+        # it; every later identical prompt is a hit
+        hits = sorted(ttfts[1:]) if on else sorted(ttfts)
+
+        def pct(q):
+            return hits[min(len(hits) - 1, int(q * (len(hits) - 1)))]
+
+        return pct(0.5), pct(0.99)
+
+    cold_p50, cold_p99 = run_prefix(False)
+    hit_p50, hit_p99 = run_prefix(True)
+    _emit("generate prefix-cache hit TTFT p99 ms", hit_p99, "ms",
+          cold_p99 / max(hit_p99, 1e-9),
+          hit_ttft_p50_ms=round(hit_p50, 3),
+          cold_ttft_p50_ms=round(cold_p50, 3),
+          cold_ttft_p99_ms=round(cold_p99, 3),
+          prompt_tokens=len(long_prompt), requests=reps,
+          baseline_note="vs_baseline = cold p99 / hit p99 on the "
+                        "identical repeated prompt; a hit skips the "
+                        "prefill program")
+
+    # ---- (c) speculative decoding on/off ---------------------------------
+    # finetune target AND draft on the same cyclic corpus so the greedy
+    # draft actually agrees with the greedy target — acceptance is what
+    # buys throughput, and it has to be earned, not faked with a clone
+    cyc_vocab, cycle = 9, [1, 2, 3, 4, 5, 6, 7, 8]
+    seq, batch_n, steps = (8, 8, 60) if SMALL else (8, 16, 150)
+    stream_ids = [cycle[i % len(cycle)]
+                  for i in range(batch_n * (seq + 1) + len(cycle))]
+
+    def cyclic_batch(off):
+        rows_x, rows_y = [], []
+        for b in range(batch_n):
+            start = (off + b) % len(cycle)
+            window = stream_ids[start:start + seq + 1]
+            rows_x.append(np.eye(cyc_vocab, dtype=np.float32)[window[:-1]])
+            rows_y.append(np.eye(cyc_vocab, dtype=np.float32)[window[1:]])
+        x = jnp.asarray(np.stack(rows_x))
+        y = jnp.asarray(np.concatenate(rows_y))
+        return x, y
+
+    target = MultiLayerNetwork(char_lstm(cyc_vocab, hidden=32, n_layers=1),
+                               seed=0).init()
+    draft = MultiLayerNetwork(char_lstm(cyc_vocab, hidden=16, n_layers=1),
+                              seed=1).init()
+    for i in range(steps):
+        x, y = cyclic_batch(i)
+        target.fit(x, y)
+        draft.fit(x, y)
+    _host_sync(target.params)
+
+    spec_k = 4
+    gen_seq, gen_new, gen_streams = 48, 32, 4 if SMALL else 8
+    target.warmup_generate(slots=2, max_seq=gen_seq, prompt_buckets=(8,))
+    target.warmup_generate(slots=2, max_seq=gen_seq, prompt_buckets=(8,),
+                           draft_net=draft, spec_k=spec_k)
+
+    def run_spec(on: bool):
+        cb = ContinuousBatcher(target, n_slots=2, max_seq=gen_seq,
+                               prompt_buckets=(8,),
+                               max_pending=gen_streams + 1,
+                               draft_net=draft if on else None,
+                               spec_k=spec_k if on else 0)
+        t0 = time.perf_counter()
+        try:
+            streams = [cb.submit(cycle[:4], max_new_tokens=gen_new)
+                       for _ in range(gen_streams)]
+            outs = [list(s.tokens(timeout=120.0)) for s in streams]
+            dt = time.perf_counter() - t0
+            st = cb.stats()
+        finally:
+            cb.stop()
+        toks = sum(len(o) for o in outs)
+        acc = (st.get("speculative") or {}).get("accepted_per_step", 0.0)
+        return toks / max(dt, 1e-9), acc, outs
+
+    plain_tps, _, plain_out = run_spec(False)
+    spec_tps, accepted, spec_out = run_spec(True)
+    assert spec_out == plain_out, "speculative greedy parity broke"
+    _emit("generate speculative tokens/sec", spec_tps, "tokens/sec",
+          spec_tps / max(plain_tps, 1e-9),
+          plain_tokens_per_sec=round(plain_tps, 1),
+          accepted_tokens_per_step=accepted, spec_k=spec_k,
+          finetune_steps=steps,
+          baseline_note="vs_baseline = speculative / plain tokens/sec, "
+                        "identical greedy trajectories; draft finetuned "
+                        "on the same cyclic corpus as the target")
+
+
 # ---------------------------------------------------------------------------
 
 # BASELINE.json configs[0..4] first, heavyweight extras after — a degraded
@@ -1765,7 +1975,7 @@ BENCHES = [bench_lenet, bench_char_lstm, bench_vgg_cifar10, bench_word2vec,
            bench_elastic_resume,
            bench_char_lstm4, bench_step_cache, bench_infer_latency,
            bench_serve, bench_serve_precision, bench_serve_router,
-           bench_fleet_slo, bench_generate,
+           bench_fleet_slo, bench_generate, bench_generate_accel,
            bench_prefetch,
            bench_cold_start, bench_north_star_cli,
            bench_attention_fused_bwd, bench_attention_crossover,

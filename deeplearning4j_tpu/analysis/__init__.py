@@ -32,6 +32,7 @@ from deeplearning4j_tpu.analysis.program_audit import (
     audit_cache,
     audit_fn,
     audit_jaxpr,
+    audit_spec_decode_parity,
     audit_zoo_models,
     collect_shapes,
     iter_eqns,
@@ -47,7 +48,8 @@ __all__ = [
     "Finding", "REPORT_VERSION", "SEVERITIES", "at_or_above", "counts",
     "render_text", "severity_rank", "to_report",
     "assert_no_materialized_scores", "audit_cache", "audit_fn",
-    "audit_jaxpr", "audit_zoo_models", "collect_shapes", "iter_eqns",
+    "audit_jaxpr", "audit_spec_decode_parity", "audit_zoo_models",
+    "collect_shapes", "iter_eqns",
     "score_scale_shapes",
     "lint_file", "lint_package", "lint_source",
 ]

@@ -22,12 +22,24 @@ invariants:
   undonated-step         a train-step program compiled without donating
                          its params buffer where donation is available
                          (double-buffers every parameter in HBM)
-  undonated-kv-cache     a decode/prefill program compiled without
-                         donating its decode-state buffers where
-                         donation is available — the KV cache is the
-                         largest live buffer in a generation server,
-                         and an undonated one is double-buffered every
-                         single token
+  undonated-kv-cache     a decode/prefill/verify program compiled
+                         without donating its decode-state buffers
+                         where donation is available — the KV cache is
+                         the largest live buffer in a generation
+                         server, and an undonated one is
+                         double-buffered every single token
+  undonated-kv-pages     the paged variant of the same rule: a
+                         decode-paged/verify-paged program compiled
+                         without donating the shared physical page
+                         pool — the pool IS the server's KV memory,
+                         so an undonated one doubles the whole
+                         generation footprint
+  spec-decode-parity     greedy speculative decoding produced a token
+                         trajectory different from plain sequential
+                         decode on a zoo model — speculation is a
+                         THROUGHPUT optimization, never a sampling
+                         change, and any divergence is a correctness
+                         bug (this rule executes, it does not trace)
   host-callback          a host callback / infeed / outfeed primitive
                          inside a compiled hot path (each one is a
                          device->host round trip per step)
@@ -297,7 +309,8 @@ def audit_cache(cache, *, expect_donation: Optional[bool] = None,
                 "train-step program compiled without donating its params "
                 "buffer — every parameter is double-buffered in HBM"))
         if (rec["kind"] == "infer-cache" and rec["key"]
-                and rec["key"][0] in ("decode", "prefill")
+                and rec["key"][0] in ("decode", "prefill", "verify",
+                                      "prefill-logp")
                 and not rec["donate_argnums"]
                 and _donation_expected(expect_donation)):
             findings.append(Finding(
@@ -305,6 +318,15 @@ def audit_cache(cache, *, expect_donation: Optional[bool] = None,
                 f"{rec['key'][0]} program compiled without donating its "
                 f"decode-state buffers — the KV cache is double-buffered "
                 f"in HBM on every token"))
+        if (rec["kind"] == "infer-cache" and rec["key"]
+                and rec["key"][0] in ("decode-paged", "verify-paged")
+                and not rec["donate_argnums"]
+                and _donation_expected(expect_donation)):
+            findings.append(Finding(
+                "undonated-kv-pages", "error", f"program:{where}",
+                f"{rec['key'][0]} program compiled without donating the "
+                f"shared KV page pool — the pool is the server's entire "
+                f"generation memory, double-buffered on every step"))
         closed = jax.make_jaxpr(rec["build"]())(*rec["abstract"])
         findings.extend(audit_jaxpr(
             closed, where=where, policy=policy,
@@ -335,14 +357,14 @@ def audit_zoo_models(small: bool = True, rows: int = 4,
     (findings, programs audited).  This is what `cli analyze` and the
     tier-1 gate run: the invariant floor, checked on the programs that
     actually ship."""
-    from deeplearning4j_tpu.models.zoo import precision_eval_confs
+    from deeplearning4j_tpu.models import zoo
     from deeplearning4j_tpu.nn.decode import check_generative
     from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
     from deeplearning4j_tpu.optimize.quantize import default_calibration
 
     findings: List[Finding] = []
     n_programs = 0
-    for name, conf in precision_eval_confs(small).items():
+    for name, conf in zoo.precision_eval_confs(small).items():
         net = MultiLayerNetwork(conf, seed=0).init()
         x = default_calibration(conf, rows)
         out = net.output(x)                    # compiles the serve program
@@ -354,8 +376,18 @@ def audit_zoo_models(small: bool = True, rows: int = 4,
         else:
             # generative models also ship decode + prefill programs —
             # compile them through the same cache so the donation and
-            # jaxpr rules see exactly what a generation server runs
+            # jaxpr rules see exactly what a generation server runs,
+            # including the paged / prefix / speculative variants a
+            # flag-enabled server swaps in (the draft's own programs
+            # live in the draft's cache; its verify step lives here)
             net.warmup_generate(slots=2, max_seq=8, prompt_buckets=(4,))
+            net.warmup_generate(slots=2, max_seq=8, prompt_buckets=(4,),
+                                page_size=4, prefix_cache=True)
+            draft = MultiLayerNetwork(
+                zoo.char_lstm(conf.conf(-1).n_out, hidden=8, n_layers=1),
+                seed=0).init()
+            net.warmup_generate(slots=2, max_seq=8, prompt_buckets=(4,),
+                                draft_net=draft, spec_k=2)
         for cache in (net.step_cache, net.infer_cache):
             recs = cache.audit_records()
             n_programs += len(recs)
@@ -366,7 +398,9 @@ def audit_zoo_models(small: bool = True, rows: int = 4,
     findings.extend(audit_attention_structure())
     n_programs += 2
     findings.extend(audit_decode_structure())
-    n_programs += 1
+    n_programs += 2
+    findings.extend(audit_spec_decode_parity())
+    n_programs += 2
     return findings, n_programs
 
 
@@ -419,5 +453,70 @@ def audit_decode_structure(S: int = 1024) -> List[Finding]:
     def step(params, state, tok, pos):
         return decode_mod.decode_step(conf, params, state, tok, pos)
 
-    return audit_fn(step, (net.params, state, tok, pos),
-                    where=f"decode-step:S={S}", seq_threshold=S)
+    findings = audit_fn(step, (net.params, state, tok, pos),
+                        where=f"decode-step:S={S}", seq_threshold=S)
+
+    # the paged step gathers its context through the page table, which
+    # must not change the score shape story: scores stay [B,H,1,ctx] —
+    # ONE sequence axis — however many physical pages back the slot
+    page_size = 128
+    n_pages = -(-S // page_size)
+    pstate = decode_mod.init_paged_state(conf, 1, n_pages + 1, page_size)
+    page_table = jnp.zeros((1, n_pages), jnp.int32)
+
+    def paged_step(params, state, tok, pos, page_table):
+        return decode_mod.decode_step_paged(conf, params, state, tok,
+                                            pos, page_table)
+
+    findings += audit_fn(paged_step,
+                         (net.params, pstate, tok, pos, page_table),
+                         where=f"decode-step-paged:S={S}",
+                         seq_threshold=S)
+    return findings
+
+
+def audit_spec_decode_parity(n_new: int = 8) -> List[Finding]:
+    """Executable parity gate for speculative decoding: greedy decode
+    with a draft + verify chunk must emit EXACTLY the tokens plain
+    sequential decode emits, on both generative zoo models.  Unlike
+    every other rule here this one runs the programs (CPU-sized, a few
+    decode steps) — structural audits cannot see a wrong acceptance
+    rule, only a divergent trajectory can."""
+    from deeplearning4j_tpu.models.zoo import char_lstm, char_transformer
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.serving.batcher import ContinuousBatcher
+
+    vocab = 13
+    targets = {
+        "char_lstm": char_lstm(vocab, hidden=16, n_layers=2),
+        "char_transformer": char_transformer(vocab, d_model=16,
+                                             n_blocks=2, n_heads=2,
+                                             max_seq_len=32),
+    }
+    prompts = ([1, 2, 3, 4], [5, 6, 7])
+    findings: List[Finding] = []
+    for name, conf in targets.items():
+        net = MultiLayerNetwork(conf, seed=0).init()
+
+        def _run(**kw):
+            b = ContinuousBatcher(net, n_slots=2, max_seq=16,
+                                  prompt_buckets=(8,), **kw)
+            b.start()
+            streams = [b.submit(list(p), max_new_tokens=n_new,
+                                temperature=0.0, rng_seed=i)
+                       for i, p in enumerate(prompts)]
+            toks = [list(s.tokens(timeout=120)) for s in streams]
+            b.stop()
+            return toks
+
+        plain = _run()
+        draft = MultiLayerNetwork(char_lstm(vocab, hidden=8, n_layers=1),
+                                  seed=1).init()
+        spec = _run(draft_net=draft, spec_k=3)
+        if spec != plain:
+            findings.append(Finding(
+                "spec-decode-parity", "error", f"program:spec:{name}",
+                f"greedy speculative decode diverged from sequential "
+                f"decode on {name}: {spec} != {plain} — speculation "
+                f"changed the sampled trajectory"))
+    return findings

@@ -444,7 +444,8 @@ def cmd_warmup(args) -> int:
         # generation programs land in the same persistent store, so a
         # later `serve --generate` with matching gen_* flags starts
         # with fresh_compiles == 0
-        summary["generation"] = _warm_generate(net, args)
+        summary["generation"] = _warm_generate(net, args,
+                                               draft=_gen_draft_net(args))
         summary["infer_cache"] = net.infer_cache.stats.as_dict()
     summary["precision"] = net.serve_precision
     summary["disk_cache"] = _disk_stats(net)
@@ -473,13 +474,32 @@ def _parse_buckets(spec: str):
     return out
 
 
-def _warm_generate(net, args) -> dict:
+def _gen_draft_net(args):
+    """--gen-draft CHECKPOINT -> loaded draft net (or None), sharing the
+    target's persistent compile cache so draft programs warm to disk
+    too."""
+    path = getattr(args, "gen_draft", None)
+    if not path:
+        return None
+    if getattr(args, "gen_spec_k", 0) < 2:
+        raise SystemExit("--gen-draft requires --gen-spec-k >= 2")
+    draft = _load_model(path)
+    _attach_compile_cache(draft, args)
+    return draft
+
+
+def _warm_generate(net, args, draft=None) -> dict:
     """Compile the decode + prefill programs for the gen_* flags (shared
     by serve --generate, warmup --generate, and the generate command) —
     always BEFORE traffic, so generation starts from cache hits."""
     summary = net.warmup_generate(
         slots=args.gen_slots, max_seq=args.gen_max_seq,
-        prompt_buckets=_parse_buckets(args.gen_prompt_buckets))
+        prompt_buckets=_parse_buckets(args.gen_prompt_buckets),
+        page_size=getattr(args, "gen_page_size", 0),
+        n_pages=getattr(args, "gen_pages", 0),
+        prefix_cache=getattr(args, "gen_prefix_cache", False),
+        draft_net=draft,
+        spec_k=getattr(args, "gen_spec_k", 0))
     summary.pop("infer_cache", None)  # _build_server reports cache stats
     return summary
 
@@ -502,12 +522,24 @@ def cmd_generate(args) -> int:
         raise SystemExit(f"prompt of {len(prompt)} tokens needs "
                          f"--gen-max-seq > {len(prompt)}")
     bucket = max(4, 1 << (len(prompt) - 1).bit_length())
+    draft = _gen_draft_net(args)
     net.warmup_generate(slots=1, max_seq=args.gen_max_seq,
-                        prompt_buckets=(min(bucket, args.gen_max_seq),))
+                        prompt_buckets=(min(bucket, args.gen_max_seq),),
+                        page_size=getattr(args, "gen_page_size", 0),
+                        prefix_cache=getattr(args, "gen_prefix_cache",
+                                             False),
+                        draft_net=draft,
+                        spec_k=getattr(args, "gen_spec_k", 0))
     warmed_misses = net.infer_cache.stats.misses
     batcher = ContinuousBatcher(net, n_slots=1, max_seq=args.gen_max_seq,
                                 prompt_buckets=(min(bucket,
-                                                    args.gen_max_seq),))
+                                                    args.gen_max_seq),),
+                                page_size=getattr(args, "gen_page_size", 0),
+                                prefix_cache=getattr(args,
+                                                     "gen_prefix_cache",
+                                                     False),
+                                draft_net=draft,
+                                spec_k=getattr(args, "gen_spec_k", 0))
     try:
         t0 = time.perf_counter()
         stream = batcher.submit(prompt,
@@ -556,10 +588,12 @@ def _build_server(args):
         warmed = net.warmup(shapes, entries=("output",))["shapes"]
     generate = bool(getattr(args, "generate", False))
     gen_warmed = None
+    gen_draft = None
     if generate:
         # same rule as the predict buckets: the decode + prefill
         # programs compile (or disk-restore) before the socket opens
-        gen_warmed = _warm_generate(net, args)
+        gen_draft = _gen_draft_net(args)
+        gen_warmed = _warm_generate(net, args, draft=gen_draft)
     server = net.serve(host=args.host, port=args.port,
                        max_delay_ms=args.max_delay_ms,
                        max_pending=args.max_pending,
@@ -577,7 +611,15 @@ def _build_server(args):
                        gen_prompt_buckets=_parse_buckets(
                            getattr(args, "gen_prompt_buckets", "8"))
                        if generate else (8,),
-                       gen_max_pending=getattr(args, "gen_max_pending", 64))
+                       gen_max_pending=getattr(args, "gen_max_pending", 64),
+                       gen_page_size=getattr(args, "gen_page_size", 0),
+                       gen_pages=getattr(args, "gen_pages", 0),
+                       gen_prefix_cache=getattr(args, "gen_prefix_cache",
+                                                False),
+                       gen_prefix_match=getattr(args, "gen_prefix_match",
+                                                "exact"),
+                       gen_draft=gen_draft,
+                       gen_spec_k=getattr(args, "gen_spec_k", 0))
     summary = {"url": server.url, "warmed": warmed,
                "fresh_compiles": net.infer_cache.stats.misses,
                "batching": not args.no_batching,
@@ -870,6 +912,34 @@ def _add_generate_flags(p: argparse.ArgumentParser) -> None:
                    default=64,
                    help="queued generation streams bound; beyond it "
                         "submissions get 503")
+    p.add_argument("--gen-page-size", dest="gen_page_size", type=int,
+                   default=0,
+                   help="tokens per KV-cache page; > 0 switches decode "
+                        "to the paged pool (memory scales with live "
+                        "tokens, not slots x max-seq)")
+    p.add_argument("--gen-pages", dest="gen_pages", type=int, default=0,
+                   help="physical KV pages in the pool (0 = enough for "
+                        "every slot at full max-seq; smaller values "
+                        "overcommit admission)")
+    p.add_argument("--gen-prefix-cache", dest="gen_prefix_cache",
+                   action="store_true",
+                   help="cache prefill state by prompt digest; a "
+                        "repeated prompt skips prefill (TTFT ~ one "
+                        "decode step), token-identical to a cold start")
+    p.add_argument("--gen-prefix-match", dest="gen_prefix_match",
+                   choices=("exact", "longest"), default="exact",
+                   help="prefix-cache matching: exact prompt only, or "
+                        "longest cached prefix (suffix fed through the "
+                        "decode table)")
+    p.add_argument("--gen-draft", dest="gen_draft", default=None,
+                   help="checkpoint dir of a small recurrent draft "
+                        "model for speculative decoding (requires "
+                        "--gen-spec-k)")
+    p.add_argument("--gen-spec-k", dest="gen_spec_k", type=int, default=0,
+                   help="speculative chunk: draft proposes spec_k - 1 "
+                        "tokens, ONE verify step accepts the agreeing "
+                        "prefix (greedy output token-identical to "
+                        "non-speculative decode)")
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -974,6 +1044,19 @@ def build_parser() -> argparse.ArgumentParser:
                         "must fit in it")
     g.add_argument("--timeout", type=float, default=120.0,
                    help="bound on the whole generation (seconds)")
+    g.add_argument("--page-size", dest="gen_page_size", type=int,
+                   default=0,
+                   help="tokens per KV page; > 0 decodes through the "
+                        "paged pool (token-identical output)")
+    g.add_argument("--prefix-cache", dest="gen_prefix_cache",
+                   action="store_true",
+                   help="cache the prompt's prefill state by digest")
+    g.add_argument("--draft", dest="gen_draft", default=None,
+                   help="draft-model checkpoint dir for speculative "
+                        "decoding (requires --spec-k)")
+    g.add_argument("--spec-k", dest="gen_spec_k", type=int, default=0,
+                   help="speculative chunk size (>= 2; draft proposes "
+                        "spec_k - 1 tokens per verify step)")
     g.set_defaults(fn=cmd_generate)
 
     s = sub.add_parser("serve",

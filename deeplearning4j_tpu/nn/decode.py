@@ -27,6 +27,30 @@ State layout: one dict per layer, in layer order, as a tuple —
 The tuple-of-dicts shape makes the whole state one donatable jit
 argument whose leaves keep their shapes/dtypes across steps, so the
 compiled step can alias its cache buffers in place.
+
+Paged variant (ISSUE 16): `init_paged_state` replaces each ATTENTION
+layer's dense [B, max_S, n] table with a shared physical page pool
+  ATTENTION         {"k": [n_pages, page_size, n], "v": same}
+addressed through a per-call `page_table` [B, pages_per_slot] int32 of
+physical page ids — cache memory scales with LIVE pages, not
+slots x max_seq.  `decode_step_paged` scatters the new K/V row at
+(page_table[b, pos // page_size], pos % page_size) and gathers the
+slot's pages back into one [B, pages_per_slot * page_size, n] view
+before the same masked [B, H, ctx] score math as the dense step —
+positions the slot has not written yet sit behind the additive mask, so
+junk in unallocated pages is inert and the paged trajectory is
+token-identical to the dense one.  The host (serving/batcher.py) owns
+the free list and keeps physical page 0 as a scratch page every
+inactive slot's table rows point at.
+
+`verify_chunk` (speculative decoding) advances every row K tokens in
+ONE program — the target-model verification step: token i of the chunk
+attends causally at position pos + i against the cache, LSTM carries
+step K times in-graph, and the returned [B, K, vocab] log-probs are
+what greedy acceptance compares draft tokens against.  Rows re-walk a
+mis-speculated suffix by simply rewriting those positions next call —
+the cache never needs a rollback because `decode_step`/`verify_chunk`
+always overwrite position `pos` before attending to it.
 """
 
 from __future__ import annotations
@@ -78,6 +102,18 @@ def check_generative(conf: MultiLayerConfiguration):
     return types
 
 
+def positional_bound(conf: MultiLayerConfiguration) -> int:
+    """Hard sequence-length ceiling imposed by a learned positional
+    table, or 0 when the stack has none (one-hot / recurrent stacks
+    decode unbounded).  `params[0]["P"][pos]` clamps silently under jit
+    past this bound, so admission (serving/batcher.py) must enforce it
+    on the host — `init_state` only covers the dense-table path."""
+    types = check_generative(conf)
+    if types[0] == LayerType.EMBEDDING:
+        return int(conf.conf(0).max_seq_len or 0)
+    return 0
+
+
 def init_state(conf: MultiLayerConfiguration, batch: int, max_seq: int):
     """Fresh decode state for `batch` rows and a `max_seq`-token table."""
     types = check_generative(conf)
@@ -98,6 +134,29 @@ def init_state(conf: MultiLayerConfiguration, batch: int, max_seq: int):
             cd = compute_dtype(c)
             state.append({"k": jnp.zeros((batch, max_seq, c.n_in), cd),
                           "v": jnp.zeros((batch, max_seq, c.n_in), cd)})
+        else:
+            state.append({})
+    return tuple(state)
+
+
+def init_paged_state(conf: MultiLayerConfiguration, batch: int,
+                     n_pages: int, page_size: int):
+    """Fresh paged decode state: recurrent carries stay per-slot
+    [batch, H], but each ATTENTION layer's K/V become one shared
+    physical pool [n_pages, page_size, n] addressed through the
+    per-call page table — memory scales with pages, not
+    batch x max_seq."""
+    types = check_generative(conf)
+    state = []
+    for i, t in enumerate(types):
+        c = conf.conf(i)
+        if t in _RECURRENT:
+            state.append({"h": jnp.zeros((batch, c.n_out), jnp.float32),
+                          "c": jnp.zeros((batch, c.n_out), jnp.float32)})
+        elif t == LayerType.ATTENTION:
+            cd = compute_dtype(c)
+            state.append({"k": jnp.zeros((n_pages, page_size, c.n_in), cd),
+                          "v": jnp.zeros((n_pages, page_size, c.n_in), cd)})
         else:
             state.append({})
     return tuple(state)
@@ -146,6 +205,116 @@ def decode_step(conf: MultiLayerConfiguration, params, state, tok, pos):
     probs = OutputLayer.forward(params[len(types) - 1], out_conf, x)
     new_state.append({})
     return jnp.log(jnp.clip(probs, 1e-9, 1.0)), tuple(new_state)
+
+
+def decode_step_paged(conf: MultiLayerConfiguration, params, state, tok,
+                      pos, page_table):
+    """`decode_step` over paged ATTENTION state: page_table
+    [B, pages_per_slot] int32 routes each row's cache reads/writes
+    through the shared physical pool.  Token-identical to the dense
+    step (see layers/attention.py:decode_step_paged)."""
+    types = check_generative(conf)
+    x = token_embed(conf, params, tok, pos)
+    new_state = []
+    for i, t in enumerate(types[:-1]):
+        c = conf.conf(i)
+        impl = get_layer(c.layer_type)
+        if t in _RECURRENT:
+            h, cc = impl.step(params[i], c, x, state[i]["h"], state[i]["c"])
+            new_state.append({"h": h, "c": cc})
+            x = h
+        elif t == LayerType.ATTENTION:
+            x, kc, vc = impl.decode_step_paged(
+                params[i], c, x, state[i]["k"], state[i]["v"], pos,
+                page_table)
+            new_state.append({"k": kc, "v": vc})
+        elif t == LayerType.TRANSFORMER_FFN:
+            x = impl.forward(params[i], c, x)
+            new_state.append({})
+        else:  # EMBEDDING
+            new_state.append({})
+    out_conf = conf.conf(len(types) - 1)
+    probs = OutputLayer.forward(params[len(types) - 1], out_conf, x)
+    new_state.append({})
+    return jnp.log(jnp.clip(probs, 1e-9, 1.0)), tuple(new_state)
+
+
+def _verify_chunk_impl(conf, params, state, toks, pos, page_table):
+    """Shared body of `verify_chunk` / `verify_chunk_paged`: advance
+    every row K tokens in one pass and return per-position log-probs.
+
+    toks [B, K] int32 — toks[:, 0] is the row's current token, the rest
+    are draft continuations; pos [B] int32 is the position of
+    toks[:, 0].  Returns (logp [B, K, vocab], new_state, carries):
+    logp[:, i] is the next-token distribution AFTER consuming
+    toks[:, :i+1], exactly what `decode_step` would return on the i-th
+    of K sequential calls.  `carries` holds, per recurrent layer, the
+    INTERMEDIATE carries {"h"/"c": [B, K, hidden]} after each of the K
+    steps ({} for every other layer): attention state self-heals on
+    mis-speculation (rejected positions are rewritten before they are
+    read) but a recurrent carry does not, so the caller must roll the
+    returned final state back to carry index e-1 when it accepts only
+    e < K tokens.
+    """
+    types = check_generative(conf)
+    b, kk = toks.shape
+    idx = pos[:, None] + jnp.arange(kk)[None, :]
+    x = token_embed(conf, params, toks, idx)  # [B, K, n]
+    new_state = []
+    carries = []
+    for i, t in enumerate(types[:-1]):
+        c = conf.conf(i)
+        impl = get_layer(c.layer_type)
+        if t in _RECURRENT:
+            h, cc = state[i]["h"], state[i]["c"]
+            outs, hs, cs = [], [], []
+            for j in range(kk):  # K is small and static — unrolled
+                h, cc = impl.step(params[i], c, x[:, j], h, cc)
+                outs.append(h)
+                hs.append(h)
+                cs.append(cc)
+            new_state.append({"h": h, "c": cc})
+            carries.append({"h": jnp.stack(hs, axis=1),
+                            "c": jnp.stack(cs, axis=1)})
+            x = jnp.stack(outs, axis=1)
+        elif t == LayerType.ATTENTION:
+            if page_table is None:
+                x, kc, vc = impl.verify_chunk(
+                    params[i], c, x, state[i]["k"], state[i]["v"], pos)
+            else:
+                x, kc, vc = impl.verify_chunk_paged(
+                    params[i], c, x, state[i]["k"], state[i]["v"], pos,
+                    page_table)
+            new_state.append({"k": kc, "v": vc})
+            carries.append({})
+        elif t == LayerType.TRANSFORMER_FFN:
+            x = impl.forward(params[i], c, x)
+            new_state.append({})
+            carries.append({})
+        else:  # EMBEDDING
+            new_state.append({})
+            carries.append({})
+    out_conf = conf.conf(len(types) - 1)
+    probs = OutputLayer.forward(params[len(types) - 1], out_conf,
+                                x.reshape(b * kk, -1))
+    probs = probs.reshape(b, kk, -1)
+    new_state.append({})
+    carries.append({})
+    return (jnp.log(jnp.clip(probs, 1e-9, 1.0)), tuple(new_state),
+            tuple(carries))
+
+
+def verify_chunk(conf: MultiLayerConfiguration, params, state, toks, pos):
+    """Speculative verification over dense decode state (see
+    `_verify_chunk_impl`)."""
+    return _verify_chunk_impl(conf, params, state, toks, pos, None)
+
+
+def verify_chunk_paged(conf: MultiLayerConfiguration, params, state, toks,
+                       pos, page_table):
+    """Speculative verification over paged decode state (see
+    `_verify_chunk_impl`)."""
+    return _verify_chunk_impl(conf, params, state, toks, pos, page_table)
 
 
 def prefill(conf: MultiLayerConfiguration, params, state, prompt, length):

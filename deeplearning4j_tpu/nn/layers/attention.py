@@ -198,6 +198,119 @@ class MultiHeadAttentionLayer:
                          params["Wo"], conf) + params["bo"]
         return x + o, k_cache, v_cache
 
+    @staticmethod
+    def decode_step_paged(params, conf, x, k_pool, v_pool, pos, page_table):
+        """`decode_step` against a shared physical page pool.
+
+        x: [B, n]; pools: [n_pages, page_size, n]; pos: [B] int32;
+        page_table: [B, pages_per_slot] int32 of physical page ids.  The
+        new K/V row is scattered at (page_table[b, pos // ps], pos % ps)
+        and the row's pages are gathered back into one
+        [B, pages_per_slot * ps, n] view before the identical masked
+        score math as the dense step — unallocated table entries point
+        at the host's scratch page, whose junk sits behind the additive
+        mask (exp(-1e30 + ·) underflows to exactly 0.0), so paged and
+        dense trajectories are token-identical.
+        """
+        b, n = x.shape
+        h = conf.n_heads
+        hd = n // h
+        ps = k_pool.shape[1]
+        cd = compute_dtype(conf)
+        xn = _layer_norm(x, params["ln_g"], params["ln_b"])
+        qkv = mixed_matmul(xn, params["Wqkv"], conf) + params["bqkv"]
+        q, k, v = jnp.split(qkv.astype(cd), 3, axis=-1)
+        rows = jnp.arange(b)
+        phys = page_table[rows, pos // ps]
+        off = pos % ps
+        k_pool = k_pool.at[phys, off].set(k.astype(k_pool.dtype))
+        v_pool = v_pool.at[phys, off].set(v.astype(v_pool.dtype))
+        pp = page_table.shape[1]
+        ctx = pp * ps
+        qh = q.reshape(b, h, hd)
+        kh = k_pool[page_table].reshape(b, ctx, h, hd).astype(cd)
+        vh = v_pool[page_table].reshape(b, ctx, h, hd).astype(cd)
+        s = jnp.einsum("bhd,bkhd->bhk", qh, kh) / jnp.sqrt(
+            jnp.asarray(hd, qh.dtype))
+        kpos = jnp.arange(ctx)[None, :]
+        mask = jnp.where(kpos <= pos[:, None], 0.0, -1e30).astype(s.dtype)
+        p = jax.nn.softmax(s + mask[:, None, :], axis=-1)
+        o = jnp.einsum("bhk,bkhd->bhd", p, vh)
+        o = mixed_matmul(o.reshape(b, n).astype(x.dtype),
+                         params["Wo"], conf) + params["bo"]
+        return x + o, k_pool, v_pool
+
+    @staticmethod
+    def verify_chunk(params, conf, x, k_cache, v_cache, pos):
+        """Speculative verification: advance every row K tokens at once.
+
+        x: [B, K, n] (chunk hidden rows); caches: [B, max_S, n]; pos:
+        [B] int32, the position of each row's FIRST chunk token.  Token
+        i is written at pos + i and attends causally at kpos <= pos + i
+        — the same mask `decode_step` would apply i calls later — so the
+        chunk's hidden rows match K sequential decode steps exactly.
+        Mis-speculated suffixes need no rollback: the next call simply
+        rewrites those positions before attending to them.
+        """
+        b, kk, n = x.shape
+        h = conf.n_heads
+        hd = n // h
+        cd = compute_dtype(conf)
+        xn = _layer_norm(x, params["ln_g"], params["ln_b"])
+        qkv = mixed_matmul(xn, params["Wqkv"], conf) + params["bqkv"]
+        q, k, v = jnp.split(qkv.astype(cd), 3, axis=-1)
+        rows = jnp.arange(b)[:, None]
+        idx = pos[:, None] + jnp.arange(kk)[None, :]
+        k_cache = k_cache.at[rows, idx].set(k.astype(k_cache.dtype))
+        v_cache = v_cache.at[rows, idx].set(v.astype(v_cache.dtype))
+        max_s = k_cache.shape[1]
+        qh = q.reshape(b, kk, h, hd)
+        kh = k_cache.astype(cd).reshape(b, max_s, h, hd)
+        vh = v_cache.astype(cd).reshape(b, max_s, h, hd)
+        s = jnp.einsum("bqhd,bkhd->bhqk", qh, kh) / jnp.sqrt(
+            jnp.asarray(hd, qh.dtype))
+        kpos = jnp.arange(max_s)[None, None, :]
+        mask = jnp.where(kpos <= idx[:, :, None], 0.0, -1e30).astype(s.dtype)
+        p = jax.nn.softmax(s + mask[:, None, :, :], axis=-1)
+        o = jnp.einsum("bhqk,bkhd->bqhd", p, vh)
+        o = mixed_matmul(o.reshape(b, kk, n).astype(x.dtype),
+                         params["Wo"], conf) + params["bo"]
+        return x + o, k_cache, v_cache
+
+    @staticmethod
+    def verify_chunk_paged(params, conf, x, k_pool, v_pool, pos, page_table):
+        """`verify_chunk` against the physical page pool — scatter each
+        chunk token at its (page, offset) and gather the paged context
+        once; mask semantics identical to the dense chunk."""
+        b, kk, n = x.shape
+        h = conf.n_heads
+        hd = n // h
+        ps = k_pool.shape[1]
+        cd = compute_dtype(conf)
+        xn = _layer_norm(x, params["ln_g"], params["ln_b"])
+        qkv = mixed_matmul(xn, params["Wqkv"], conf) + params["bqkv"]
+        q, k, v = jnp.split(qkv.astype(cd), 3, axis=-1)
+        rows = jnp.arange(b)[:, None]
+        idx = pos[:, None] + jnp.arange(kk)[None, :]
+        phys = page_table[rows, idx // ps]
+        off = idx % ps
+        k_pool = k_pool.at[phys, off].set(k.astype(k_pool.dtype))
+        v_pool = v_pool.at[phys, off].set(v.astype(v_pool.dtype))
+        pp = page_table.shape[1]
+        ctx = pp * ps
+        qh = q.reshape(b, kk, h, hd)
+        kh = k_pool[page_table].reshape(b, ctx, h, hd).astype(cd)
+        vh = v_pool[page_table].reshape(b, ctx, h, hd).astype(cd)
+        s = jnp.einsum("bqhd,bkhd->bhqk", qh, kh) / jnp.sqrt(
+            jnp.asarray(hd, qh.dtype))
+        kpos = jnp.arange(ctx)[None, None, :]
+        mask = jnp.where(kpos <= idx[:, :, None], 0.0, -1e30).astype(s.dtype)
+        p = jax.nn.softmax(s + mask[:, None, :, :], axis=-1)
+        o = jnp.einsum("bhqk,bkhd->bqhd", p, vh)
+        o = mixed_matmul(o.reshape(b, kk, n).astype(x.dtype),
+                         params["Wo"], conf) + params["bo"]
+        return x + o, k_pool, v_pool
+
 
 def _layer_norm(x, g, b, eps: float = 1e-5):
     mu = jnp.mean(x, axis=-1, keepdims=True)

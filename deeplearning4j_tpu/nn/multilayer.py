@@ -527,24 +527,64 @@ class MultiLayerNetwork:
         }
 
     def warmup_generate(self, slots: int = 4, max_seq: int = 64,
-                        prompt_buckets: Sequence[int] = (8,)):
+                        prompt_buckets: Sequence[int] = (8,),
+                        page_size: int = 0, n_pages: int = 0,
+                        prefix_cache: bool = False, draft_net=None,
+                        spec_k: int = 0):
         """Precompile the autoregressive generation programs (ISSUE 14)
         ahead of traffic: ONE decode step over the `slots`-wide table
         plus one prefill program per prompt bucket (each admission
-        prefills a single row, so prefill compiles at B=1).  With a
-        persistent store attached the programs land on disk like every
-        other warmup — a restarted serve process starts generating with
-        `fresh_compiles == 0`.  Returns a summary with the cache stats."""
+        prefills a single row, so prefill compiles at B=1).  The
+        optional decode accelerators (ISSUE 16) each swap or add
+        programs, and the warmup mirrors the serving batcher exactly so
+        `fresh_compiles == 0` holds for ANY flag combination:
+        `page_size > 0` warms the paged decode step over the shared
+        page pool instead of the dense one; `prefix_cache` warms the
+        logp-returning prefill the prefix cache records instead of the
+        sampling prefill; `draft_net` + `spec_k` warm the batched
+        verify step plus the draft model's own decode/prefill programs.
+        With a persistent store attached the programs land on disk like
+        every other warmup — a restarted serve process starts
+        generating with `fresh_compiles == 0`.  Returns a summary with
+        the cache stats."""
         if self.params is None:
             self.init()
         ic = self.infer_cache
-        state = ic.init_decode_state(self.conf, slots, max_seq)
         tok = jnp.zeros((slots,), jnp.int32)
         pos = jnp.zeros((slots,), jnp.int32)
         keys = jnp.zeros((slots, 2), jnp.uint32)
         temps = jnp.zeros((slots,), jnp.float32)
-        ic.decode(self.conf, self.params, state, tok, pos, keys, temps,
-                  compile_only=True)
+        page_size = int(page_size)
+        page_table = None
+        if page_size > 0:
+            # identical pool geometry to ContinuousBatcher: physical
+            # page 0 is the scratch page, so the pool holds n_pages + 1
+            pages_per_slot = -(-int(max_seq) // page_size)
+            pool_pages = int(n_pages) or int(slots) * pages_per_slot
+            state = ic.init_paged_decode_state(
+                self.conf, slots, pool_pages + 1, page_size)
+            page_table = jnp.zeros((slots, pages_per_slot), jnp.int32)
+            ic.decode_paged(self.conf, self.params, state, tok, pos,
+                            keys, temps, page_table, compile_only=True)
+        else:
+            state = ic.init_decode_state(self.conf, slots, max_seq)
+            ic.decode(self.conf, self.params, state, tok, pos, keys,
+                      temps, compile_only=True)
+        if draft_net is not None:
+            if int(spec_k) < 2:
+                raise ValueError("draft_net requires spec_k >= 2")
+            toks = jnp.zeros((slots, int(spec_k)), jnp.int32)
+            if page_size > 0:
+                ic.verify_paged(self.conf, self.params, state, toks,
+                                pos, keys, temps, page_table,
+                                compile_only=True)
+            else:
+                ic.verify(self.conf, self.params, state, toks, pos,
+                          keys, temps, compile_only=True)
+            dic = draft_net.infer_cache
+            dstate = dic.init_decode_state(draft_net.conf, slots, max_seq)
+            dic.decode(draft_net.conf, draft_net.params, dstate, tok,
+                       pos, keys, temps, compile_only=True)
         row = ic.init_decode_state(self.conf, 1, max_seq)
         buckets = sorted(int(b) for b in prompt_buckets)
         for tb in buckets:
@@ -553,12 +593,25 @@ class MultiLayerNetwork:
                                  f"max_seq={max_seq}")
             prompt = jnp.zeros((1, tb), jnp.int32)
             length = jnp.ones((1,), jnp.int32)
-            ic.prefill(self.conf, self.params, row, prompt, length,
-                       keys[:1], temps[:1], compile_only=True)
+            if prefix_cache:
+                ic.prefill_logp(self.conf, self.params, row, prompt,
+                                length, compile_only=True)
+            else:
+                ic.prefill(self.conf, self.params, row, prompt, length,
+                           keys[:1], temps[:1], compile_only=True)
+            if draft_net is not None:
+                drow = draft_net.infer_cache.init_decode_state(
+                    draft_net.conf, 1, max_seq)
+                draft_net.infer_cache.prefill(
+                    draft_net.conf, draft_net.params, drow, prompt,
+                    length, keys[:1], temps[:1], compile_only=True)
         return {
             "slots": int(slots),
             "max_seq": int(max_seq),
             "prompt_buckets": buckets,
+            "page_size": page_size,
+            "prefix_cache": bool(prefix_cache),
+            "spec_k": int(spec_k) if draft_net is not None else 0,
             "infer_cache": ic.stats.as_dict(),
         }
 
@@ -571,7 +624,10 @@ class MultiLayerNetwork:
               default_deadline_ms=None, breaker=None,
               generate: bool = False, gen_slots: int = 4,
               gen_max_seq: int = 64, gen_prompt_buckets=(8,),
-              gen_max_pending: int = 64):
+              gen_max_pending: int = 64, gen_page_size: int = 0,
+              gen_pages: int = 0, gen_prefix_cache: bool = False,
+              gen_prefix_match: str = "exact", gen_draft=None,
+              gen_spec_k: int = 0):
         """Start the micro-batching HTTP gateway over this network
         (`serving.ModelServer`): POST /v1/predict coalesces concurrent
         requests into one bucketed infer-cache call per flush, GET
@@ -600,7 +656,13 @@ class MultiLayerNetwork:
                            breaker=breaker, generate=generate,
                            gen_slots=gen_slots, gen_max_seq=gen_max_seq,
                            gen_prompt_buckets=gen_prompt_buckets,
-                           gen_max_pending=gen_max_pending).start()
+                           gen_max_pending=gen_max_pending,
+                           gen_page_size=gen_page_size,
+                           gen_pages=gen_pages,
+                           gen_prefix_cache=gen_prefix_cache,
+                           gen_prefix_match=gen_prefix_match,
+                           gen_draft=gen_draft,
+                           gen_spec_k=gen_spec_k).start()
 
     # -- inference ---------------------------------------------------------
     def _serve_cached(self, x) -> bool:

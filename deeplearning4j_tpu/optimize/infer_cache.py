@@ -364,6 +364,81 @@ class InferCache(CompiledProgramCache):
             self.stats.steps += 1
         return fn(sp, state, tok, pos, keys, temps)
 
+    # -- paged decode + speculative verification (ISSUE 16) ------------------
+    def init_paged_decode_state(self, conf, batch: int, n_pages: int,
+                                page_size: int):
+        """Fresh paged decode state (shared K/V page pool) shaped for
+        the active policy's programs."""
+        from deeplearning4j_tpu.nn import decode as decode_mod
+
+        return decode_mod.init_paged_state(
+            _policy_conf(conf, self._policy), batch, n_pages, page_size)
+
+    def decode_paged(self, conf, params, state, tok, pos, keys, temps,
+                     page_table, compile_only: bool = False):
+        """`decode` over the paged state: page_table [B, pages_per_slot]
+        int32 is a tiny per-call host argument routing each row through
+        the shared physical pool.  Same donation contract as `decode`
+        (the pool is arg 1, donated off-CPU); its key entry is
+        "decode-paged" so paged and dense programs coexist."""
+        policy, sp = self._policy, self._serve_params(params)
+        key = ("decode-paged", self._fingerprint(conf),
+               arg_signature(tok, pos, keys, temps, page_table,
+                             *jax.tree_util.tree_leaves(state)),
+               self.SINGLE) + self._policy_suffix()
+        fn = self._get(key, lambda: _decode_paged_program(conf, policy),
+                       (sp, state, tok, pos, keys, temps, page_table),
+                       donate=self._decode_donate())
+        if compile_only:
+            return None
+        with self._lock:
+            self.stats.steps += 1
+        return fn(sp, state, tok, pos, keys, temps, page_table)
+
+    def verify(self, conf, params, state, toks, pos, keys, temps,
+               compile_only: bool = False):
+        """Speculative verification step: toks [B, K] int32 (column 0 is
+        each row's current token, columns 1..K-1 the draft
+        continuations), pos [B] int32 the position of column 0.  One
+        program advances every row K positions and chain-samples K
+        tokens with the row's key stream — exactly the splits K
+        sequential `decode` calls would burn — returning (sampled
+        [B, K] int32, keys_after [B, K, 2] uint32 (the key state after
+        accepting 1..K tokens), new state).  The host accepts the
+        longest prefix where draft and sample agree; mis-speculated
+        cache rows are rewritten by the next call before being read, so
+        rollback is free."""
+        policy, sp = self._policy, self._serve_params(params)
+        key = ("verify", self._fingerprint(conf),
+               arg_signature(toks, pos, keys, temps,
+                             *jax.tree_util.tree_leaves(state)),
+               self.SINGLE) + self._policy_suffix()
+        fn = self._get(key, lambda: _verify_program(conf, policy),
+                       (sp, state, toks, pos, keys, temps),
+                       donate=self._decode_donate())
+        if compile_only:
+            return None
+        with self._lock:
+            self.stats.steps += 1
+        return fn(sp, state, toks, pos, keys, temps)
+
+    def verify_paged(self, conf, params, state, toks, pos, keys, temps,
+                     page_table, compile_only: bool = False):
+        """`verify` over the paged state ("verify-paged" key entry)."""
+        policy, sp = self._policy, self._serve_params(params)
+        key = ("verify-paged", self._fingerprint(conf),
+               arg_signature(toks, pos, keys, temps, page_table,
+                             *jax.tree_util.tree_leaves(state)),
+               self.SINGLE) + self._policy_suffix()
+        fn = self._get(key, lambda: _verify_paged_program(conf, policy),
+                       (sp, state, toks, pos, keys, temps, page_table),
+                       donate=self._decode_donate())
+        if compile_only:
+            return None
+        with self._lock:
+            self.stats.steps += 1
+        return fn(sp, state, toks, pos, keys, temps, page_table)
+
     def prefill(self, conf, params, state, prompt, length, keys, temps,
                 compile_only: bool = False):
         """Compiled prompt prefill: prompt [B, T_bucket] int32
@@ -385,6 +460,31 @@ class InferCache(CompiledProgramCache):
         with self._lock:
             self.stats.steps += 1
         return fn(sp, state, prompt, length, keys, temps)
+
+    def prefill_logp(self, conf, params, state, prompt, length,
+                     compile_only: bool = False):
+        """Prefix-cacheable prompt prefill: fills the state exactly like
+        `prefill` but returns (logp [B, vocab] f32, state) WITHOUT
+        sampling — the serving layer caches the pair by prompt digest
+        and samples each stream's first token on the host with the
+        stream's own key (the eager sampler's discipline, which the
+        compiled samplers reproduce exactly), so one cold prefill serves
+        every later stream sharing the prompt regardless of key or
+        temperature.  Only the prefix-cache flag routes admissions here;
+        with the flag off this program is never built."""
+        policy, sp = self._policy, self._serve_params(params)
+        key = ("prefill-logp", self._fingerprint(conf),
+               arg_signature(prompt, length,
+                             *jax.tree_util.tree_leaves(state)),
+               self.SINGLE) + self._policy_suffix()
+        fn = self._get(key, lambda: _prefill_logp_program(conf, policy),
+                       (sp, state, prompt, length),
+                       donate=self._decode_donate())
+        if compile_only:
+            return None
+        with self._lock:
+            self.stats.steps += 1
+        return fn(sp, state, prompt, length)
 
     def loss(self, conf, params, x, y, compile_only: bool = False):
         """`network_loss(training=False)` through the cache: the
@@ -441,6 +541,104 @@ def _sample_tokens(logp, keys, temps):
     return jnp.where(temps > 0, sampled, greedy), new_keys
 
 
+def _sample_chain(logp, keys, temps):
+    """Chain-sample one token per chunk position: position i consumes
+    logp[:, i] with the key state left by position i-1 — the identical
+    split sequence K sequential `_sample_tokens` calls would produce, so
+    an accepted chunk's tokens AND advanced keys match sequential decode
+    exactly.  Returns (toks [B, K] int32, keys_after [B, K, 2])."""
+    toks, keys_after = [], []
+    for i in range(logp.shape[1]):
+        t, keys = _sample_tokens(logp[:, i], keys, temps)
+        toks.append(t)
+        keys_after.append(keys)
+    return jnp.stack(toks, axis=1), jnp.stack(keys_after, axis=1)
+
+
+def _decode_paged_program(conf, policy: str = "f32") -> Callable:
+    from deeplearning4j_tpu.nn import decode as decode_mod
+
+    pconf = _policy_conf(conf, policy)
+
+    def program(params, state, tok, pos, keys, temps, page_table):
+        logp, state = decode_mod.decode_step_paged(
+            pconf, _policy_args(params, policy), state, tok, pos,
+            page_table)
+        if policy != "f32":
+            logp = logp.astype(jnp.float32)
+        tok2, keys2 = _sample_tokens(logp, keys, temps)
+        return tok2, keys2, state
+
+    return program
+
+
+def _accepted_len(toks, sampled):
+    """Acceptance length per row, in-program: e = 1 + the number of
+    leading draft proposals toks[:, 1:] that equal the target's own
+    chain samples sampled[:, :-1] (the guaranteed first token plus the
+    agreeing prefix).  Integer comparisons — bit-identical to the host
+    loop the serving batcher runs on the fetched arrays."""
+    b, kk = toks.shape
+    if kk == 1:
+        return jnp.ones((b,), jnp.int32)
+    agree = (toks[:, 1:] == sampled[:, :-1]).astype(jnp.int32)
+    return 1 + jnp.sum(jnp.cumprod(agree, axis=1), axis=1)
+
+
+def _rollback_carries(state, carries, e):
+    """Replace each recurrent layer's final carry in `state` with the
+    intermediate carry after the e-th verified token (index e-1 of the
+    [B, K, hidden] stacks): attention K/V self-heals on mis-speculation
+    (rejected positions are overwritten before they are read) but a
+    recurrent carry advanced past the accepted prefix would poison
+    every later token."""
+    rows = jnp.arange(e.shape[0])
+    out = []
+    for lay, car in zip(state, carries):
+        if car:
+            out.append({k: v[rows, e - 1] for k, v in car.items()})
+        else:
+            out.append(lay)
+    return tuple(out)
+
+
+def _verify_program(conf, policy: str = "f32") -> Callable:
+    from deeplearning4j_tpu.nn import decode as decode_mod
+
+    pconf = _policy_conf(conf, policy)
+
+    def program(params, state, toks, pos, keys, temps):
+        logp, state, carries = decode_mod.verify_chunk(
+            pconf, _policy_args(params, policy), state, toks, pos)
+        if policy != "f32":
+            logp = logp.astype(jnp.float32)
+        sampled, keys_after = _sample_chain(logp, keys, temps)
+        state = _rollback_carries(state, carries,
+                                  _accepted_len(toks, sampled))
+        return sampled, keys_after, state
+
+    return program
+
+
+def _verify_paged_program(conf, policy: str = "f32") -> Callable:
+    from deeplearning4j_tpu.nn import decode as decode_mod
+
+    pconf = _policy_conf(conf, policy)
+
+    def program(params, state, toks, pos, keys, temps, page_table):
+        logp, state, carries = decode_mod.verify_chunk_paged(
+            pconf, _policy_args(params, policy), state, toks, pos,
+            page_table)
+        if policy != "f32":
+            logp = logp.astype(jnp.float32)
+        sampled, keys_after = _sample_chain(logp, keys, temps)
+        state = _rollback_carries(state, carries,
+                                  _accepted_len(toks, sampled))
+        return sampled, keys_after, state
+
+    return program
+
+
 def _decode_program(conf, policy: str = "f32") -> Callable:
     from deeplearning4j_tpu.nn import decode as decode_mod
 
@@ -469,6 +667,19 @@ def _prefill_program(conf, policy: str = "f32") -> Callable:
             logp = logp.astype(jnp.float32)
         tok0, keys2 = _sample_tokens(logp, keys, temps)
         return tok0, keys2, state
+
+    return program
+
+
+def _prefill_logp_program(conf, policy: str = "f32") -> Callable:
+    from deeplearning4j_tpu.nn import decode as decode_mod
+
+    pconf = _policy_conf(conf, policy)
+
+    def program(params, state, prompt, length):
+        logp, state = decode_mod.prefill(
+            pconf, _policy_args(params, policy), state, prompt, length)
+        return logp.astype(jnp.float32), state
 
     return program
 

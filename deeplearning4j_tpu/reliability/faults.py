@@ -53,6 +53,18 @@ Injection points wired into the codebase:
                           a mid-generation fault ends that slot's
                           stream with an error while its neighbours
                           finish their tokens
+  ``decode.page_alloc``   per KV-page allocation in the paged decode
+                          pool (serving/batcher.py): an armed raise
+                          (or genuine exhaustion) at admission queues
+                          the stream; mid-decode it fails ONE stream
+                          cleanly while its neighbours keep their
+                          pages and keep decoding
+  ``generate.prefix_lookup``  per prefix-cache probe during stream
+                          admission (serving/batcher.py) — an armed
+                          raise simulates a corrupt/missing cache
+                          entry; the batcher must degrade to a cold
+                          prefill (counted miss), never fail the
+                          stream
 
 The registry is generic — tests may `fire()` arbitrary point names of
 their own.  With nothing armed, `fire()` is a counter bump under a lock:
@@ -112,6 +124,10 @@ DOCUMENTED_POINTS = {
                       "ContinuousBatcher (serving/batcher.py)",
     "decode.step": "per active slot per decode-table step in "
                    "ContinuousBatcher (serving/batcher.py)",
+    "decode.page_alloc": "per KV-page allocation in the paged decode "
+                         "pool (serving/batcher.py)",
+    "generate.prefix_lookup": "per prefix-cache probe during stream "
+                              "admission (serving/batcher.py)",
 }
 
 _PLAN_RE = re.compile(

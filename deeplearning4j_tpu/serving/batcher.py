@@ -40,10 +40,12 @@ Resilience (ISSUE 5):
 
 from __future__ import annotations
 
+import hashlib
+import io
 import queue
 import threading
 import time
-from collections import deque
+from collections import OrderedDict, deque
 from typing import Deque, Dict, List, Optional, Tuple
 
 import numpy as np
@@ -69,9 +71,84 @@ PRIORITIES = ("interactive", "batch")
 LATENCY_BUCKETS_S = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
                      0.25, 0.5, 1.0, 2.5, 5.0, 10.0)
 
+#: histogram bucket bounds for tokens accepted per speculative verify
+#: step (`le` upper bounds; a round always accepts >= 1)
+ACCEPTED_TOKENS_BOUNDS = (1, 2, 3, 4, 6, 8, 12, 16)
+
+#: in-memory prefix-cache entries kept per batcher (LRU; the disk store,
+#: when attached, holds evicted entries too)
+PREFIX_CACHE_ENTRIES = 32
+
 
 class ServerOverloaded(RuntimeError):
     """The gateway's pending queue is full — fail fast (HTTP 503)."""
+
+
+class PagesExhausted(RuntimeError):
+    """The KV page pool has no free page for the request.  At admission
+    this queues the stream (pages free as live streams finish); past the
+    admission gate — overcommitted pools only — it ends the one stream
+    that could not grow, never the table."""
+
+
+class _PagePool:
+    """Host-side free list over the physical K/V page pool.
+
+    Physical page 0 is the scratch page: every released slot's page
+    table points there, so junk written for inactive rows lands behind
+    the additive mask instead of in anyone's context.  Usable pages are
+    1..n_pages; `alloc` traverses the `decode.page_alloc` fault point
+    (an armed raise fails the ONE stream being grown) and raises
+    `PagesExhausted` when the request exceeds the free list."""
+
+    def __init__(self, n_pages: int):
+        self.n_pages = int(n_pages)
+        # pop() hands out ascending ids: 1, 2, ...
+        self._free = list(range(self.n_pages, 0, -1))
+
+    @property
+    def free_count(self) -> int:
+        return len(self._free)
+
+    @property
+    def live_count(self) -> int:
+        return self.n_pages - len(self._free)
+
+    def alloc(self, n: int, **ctx) -> List[int]:
+        faults.fire("decode.page_alloc", requested=n,
+                    free=len(self._free), **ctx)
+        if n > len(self._free):
+            raise PagesExhausted(
+                f"{n} KV pages requested, {len(self._free)} free "
+                f"(pool={self.n_pages})")
+        return [self._free.pop() for _ in range(n)]
+
+    def free(self, pages) -> None:
+        for p in pages:
+            if int(p):
+                self._free.append(int(p))
+
+
+def _host_sample(logp, key, temperature: float):
+    """One row of `InferCache._sample_tokens` on the host: split the
+    stream's key once, argmax when temperature <= 0, else
+    `categorical(sub, logp / temperature)` — the eager sampler's exact
+    discipline (models/char_lstm.py:140), which the compiled programs
+    already reproduce bit-for-bit.  This is what lets one cached prefill
+    logp serve streams with different keys and temperatures.  Returns
+    (token int, advanced key np.uint32[2])."""
+    import jax
+    import jax.numpy as jnp
+
+    ks = np.asarray(jax.random.split(jnp.asarray(key)))
+    new_key, sub = ks[0], ks[1]
+    if temperature > 0:
+        tok = int(jax.random.categorical(
+            jnp.asarray(sub),
+            jnp.asarray(logp, jnp.float32) / np.float32(temperature)))
+    else:
+        tok = int(np.argmax(np.asarray(logp, np.float32)))
+    return tok, new_key
 
 
 class _Pending:
@@ -520,6 +597,10 @@ class GenerationStream:
         self.key = np.asarray(jax.random.PRNGKey(int(rng_seed)))
         self.error: Optional[BaseException] = None
         self.tokens_emitted = 0
+        #: tokens to swallow on readmission after a page-pool
+        #: preemption (the recompute re-derives the delivered prefix)
+        self._replay = 0
+        self._counted_admit = False
         self.t_submit = time.monotonic()
         self.t_first: Optional[float] = None
         self.t_done: Optional[float] = None
@@ -531,6 +612,18 @@ class GenerationStream:
             self.t_first = now
         self.tokens_emitted += 1
         self._q.put(int(tok))
+
+    def _deliver(self, tok: int, now: float) -> bool:
+        """Emit `tok` unless it replays an already-delivered token
+        after a page-pool preemption: decode is deterministic given
+        (prompt, key), so a recomputed stream re-derives exactly the
+        prefix the consumer already has, and those tokens are swallowed
+        rather than duplicated."""
+        if self._replay > 0:
+            self._replay -= 1
+            return False
+        self._emit(tok, now)
+        return True
 
     def _finish(self, error: Optional[BaseException] = None) -> None:
         self.error = error
@@ -578,12 +671,40 @@ class ContinuousBatcher:
     table and LSTM state and its own PRNG key), so slot packing never
     changes a stream's tokens — a greedy stream reproduces the eager
     sampler's trajectory exactly regardless of its neighbours.
+
+    Three conf-gated decode optimizations (ISSUE 16), each
+    token-identical to the plain path and OFF by default:
+
+    page_size > 0   paged KV: the dense [slots, max_seq, n] tables
+                    become a shared physical page pool + per-slot page
+                    tables; memory scales with live tokens, `n_pages`
+                    can overcommit `n_slots` (admission queues on a dry
+                    pool, it never crashes).
+    prefix_cache    prefill keyed by prompt digest: a repeated prompt
+                    copies the cached row state and samples its first
+                    token from the cached logp on the host — TTFT is
+                    one eager sample, not a prefill.  `prefix_match=
+                    "longest"` additionally reuses the longest cached
+                    strict prefix and feeds the remaining prompt tokens
+                    through the decode table.
+    draft_net+spec_k speculative decoding: the (recurrent-only) draft
+                    proposes spec_k - 1 tokens, one batched verify step
+                    chain-samples against them, and the agreeing prefix
+                    is accepted — the emitted tokens ARE the target's
+                    own chain samples, so trajectories match sequential
+                    decode at any temperature.
     """
 
     def __init__(self, net, n_slots: int = 4, max_seq: int = 64,
                  prompt_buckets: Tuple[int, ...] = (8,),
                  max_pending: int = 64, continuous: bool = True,
-                 auto_start: bool = True):
+                 auto_start: bool = True, page_size: int = 0,
+                 n_pages: int = 0, prefix_cache: bool = False,
+                 prefix_match: str = "exact", draft_net=None,
+                 spec_k: int = 0):
+        from deeplearning4j_tpu.nn import decode as decode_mod
+        from deeplearning4j_tpu.nn.conf import LayerType
+
         self.net = net
         self.n_slots = int(n_slots)
         self.max_seq = int(max_seq)
@@ -592,6 +713,71 @@ class ContinuousBatcher:
         self.max_pending = int(max_pending)
         self.continuous = bool(continuous)
         self._auto_start = auto_start
+        self._layer_types = decode_mod.check_generative(net.conf)
+        # silent positional-table overrun fix: `token_embed` gathers
+        # P[pos] with no bound check, and jit CLAMPS out-of-range
+        # gathers — a stream decoding past the learned table would read
+        # the last row forever instead of failing.  The table edge
+        # (`submit` clamps max_new to max_seq - n) bounds every pos, so
+        # rejecting max_seq > bound here closes the hole for the paged
+        # path too, which has no [B, max_seq] dense table to trip the
+        # `init_state` check.
+        bound = decode_mod.positional_bound(net.conf)
+        if bound and self.max_seq > bound:
+            raise ValueError(
+                f"max_seq={self.max_seq} exceeds the learned positional "
+                f"table (max_seq_len={bound}); decoding past it would "
+                f"silently clamp P[pos] gathers")
+        # -- paged KV (page 0 = scratch; usable pages are 1..n_pages) ------
+        self.page_size = int(page_size)
+        self.paged = self.page_size > 0
+        if self.paged:
+            self.pages_per_slot = -(-self.max_seq // self.page_size)
+            self.n_pages = int(n_pages) or self.n_slots * self.pages_per_slot
+            if self.n_pages < self.pages_per_slot:
+                raise ValueError(
+                    f"n_pages={self.n_pages} cannot hold even one "
+                    f"max_seq={self.max_seq} stream "
+                    f"({self.pages_per_slot} pages of {self.page_size})")
+            self._pool: Optional[_PagePool] = _PagePool(self.n_pages)
+            self._page_table = np.zeros(
+                (self.n_slots, self.pages_per_slot), np.int32)
+        else:
+            self.pages_per_slot = 0
+            self.n_pages = 0
+            self._pool = None
+            self._page_table = None
+        # -- prefix cache --------------------------------------------------
+        self.prefix_cache_enabled = bool(prefix_cache)
+        if prefix_match not in ("exact", "longest"):
+            raise ValueError(
+                f"prefix_match must be 'exact' or 'longest', "
+                f"got {prefix_match!r}")
+        self.prefix_match = prefix_match
+        self._prefix_lru: "OrderedDict[str, tuple]" = OrderedDict()
+        self._prefix_hits = 0
+        self._prefix_misses = 0
+        # -- speculative decoding ------------------------------------------
+        self.draft_net = draft_net
+        self.spec_k = int(spec_k) if draft_net is not None else 0
+        if draft_net is not None:
+            if self.spec_k < 2:
+                raise ValueError(
+                    "speculative decoding needs spec_k >= 2 (current "
+                    "token + at least one draft position per verify)")
+            d_types = decode_mod.check_generative(draft_net.conf)
+            if any(t == LayerType.ATTENTION for t in d_types):
+                raise ValueError(
+                    "the draft model must be recurrent-only: rejected "
+                    "draft tokens roll its carries back to a retained "
+                    "copy, which K/V tables are too large to retain "
+                    "per position")
+            dbound = decode_mod.positional_bound(draft_net.conf)
+            if dbound and self.max_seq > dbound:
+                raise ValueError(
+                    f"max_seq={self.max_seq} exceeds the DRAFT model's "
+                    f"positional table (max_seq_len={dbound})")
+        self._draft_state = None  # device tree, B = n_slots (spec only)
         self._cv = threading.Condition()
         self._pending: Deque[GenerationStream] = deque()
         self._stop = False
@@ -603,12 +789,19 @@ class ContinuousBatcher:
         self._pos = np.zeros((self.n_slots,), np.int32)
         self._keys = np.zeros((self.n_slots, 2), np.uint32)
         self._temps = np.zeros((self.n_slots,), np.float32)
+        # prompt tokens still to feed through a longest-prefix-matched
+        # slot (decode-loop thread only; empty with the flag off)
+        self._feed: List[List[int]] = [[] for _ in range(self.n_slots)]
+        self._spec_rounds = 0
+        self._accept_hist = {"counts": [0] * len(ACCEPTED_TOKENS_BOUNDS),
+                             "inf": 0, "sum": 0.0, "count": 0}
         # -- stats (guarded by _cv's lock) ---------------------------------
         self._t_start = time.monotonic()
         self._tokens_total = 0
         self._admitted = 0
         self._completed = 0
         self._failed = 0
+        self._preempted = 0
         self._active = 0
         self._recent_tokens: Deque[Tuple[float, int]] = deque()
         self._ttfts: Deque[float] = deque(maxlen=4096)
@@ -622,8 +815,18 @@ class ContinuousBatcher:
                 return self
             self._stop = False
             if self._state is None:
-                self._state = self.net.infer_cache.init_decode_state(
-                    self.net.conf, self.n_slots, self.max_seq)
+                if self.paged:
+                    # pool row 0 is the scratch page — physical pool =
+                    # usable pages + 1
+                    self._state = self.net.infer_cache.init_paged_decode_state(
+                        self.net.conf, self.n_slots, self.n_pages + 1,
+                        self.page_size)
+                else:
+                    self._state = self.net.infer_cache.init_decode_state(
+                        self.net.conf, self.n_slots, self.max_seq)
+            if self.draft_net is not None and self._draft_state is None:
+                self._draft_state = self.draft_net.infer_cache.init_decode_state(
+                    self.draft_net.conf, self.n_slots, self.max_seq)
             self._thread = threading.Thread(
                 target=self._decode_loop, name="dl4j-decode", daemon=True)
             self._thread.start()
@@ -694,49 +897,257 @@ class ContinuousBatcher:
     def _admit_one(self, slot: int, stream: GenerationStream) -> None:
         """Prefill `stream` into `slot`: one B=1 prefill program fills a
         row state and samples the stream's first token (TTFT = this
-        call), then the row is scattered into the slot table."""
-        import jax
+        call), then the row is scattered into the slot table.
 
+        A prefix-cache hit skips the prefill entirely: the cached row
+        state is scattered and (exact match) the first token is sampled
+        on the host from the cached logp with the stream's own key, or
+        (longest match) the unmatched prompt suffix is queued to feed
+        through the decode table.  Either way the token trajectory is
+        identical to a cold prefill."""
         ic = self.net.infer_cache
         faults.fire("generate.admit", slot=slot,
                     prompt_tokens=int(stream.prompt.shape[0]))
         n = int(stream.prompt.shape[0])
-        bucket = self._prompt_bucket(n)
-        prompt = np.zeros((1, bucket), np.int32)
-        prompt[0, :n] = stream.prompt
-        length = np.asarray([n], np.int32)
-        temps = np.asarray([stream.temperature], np.float32)
-        row = ic.init_decode_state(self.net.conf, 1, self.max_seq)
-        tok0, keys1, row = ic.prefill(self.net.conf, self.net.params, row,
-                                      prompt, length, stream.key[None],
-                                      temps)
-        self._state = jax.tree_util.tree_map(
-            lambda tbl, r: tbl.at[slot].set(r[0]), self._state, row)
+        hit = (self._prefix_lookup(stream.prompt)
+               if self.prefix_cache_enabled else None)
+        m = n if hit is None else int(hit[0])
+        pages: Optional[List[int]] = None
+        if self.paged:
+            # allocate the admission pages before any device work, so a
+            # dry pool queues the stream instead of wasting a prefill
+            pages = self._pool.alloc(-(-m // self.page_size), slot=slot)
+        tok0 = key1 = None
+        if hit is None:
+            bucket = self._prompt_bucket(n)
+            prompt = np.zeros((1, bucket), np.int32)
+            prompt[0, :n] = stream.prompt
+            length = np.asarray([n], np.int32)
+            row = ic.init_decode_state(self.net.conf, 1, self.max_seq)
+            if self.prefix_cache_enabled:
+                logp, row = ic.prefill_logp(self.net.conf, self.net.params,
+                                            row, prompt, length)
+                logp = np.asarray(logp[0], np.float32)
+                self._prefix_store(stream.prompt, logp, row)
+                tok0, key1 = _host_sample(logp, stream.key,
+                                          stream.temperature)
+            else:
+                temps = np.asarray([stream.temperature], np.float32)
+                t0, keys1, row = ic.prefill(self.net.conf, self.net.params,
+                                            row, prompt, length,
+                                            stream.key[None], temps)
+                tok0, key1 = int(t0[0]), np.asarray(keys1[0])
+        else:
+            row = hit[2]
+            if hit[1] is not None:  # exact match: cached prefill logp
+                tok0, key1 = _host_sample(hit[1], stream.key,
+                                          stream.temperature)
+        self._scatter_row(slot, row, pages)
+        if self.draft_net is not None:
+            # the draft consumes exactly the m tokens the target row has
+            # consumed, so feed rounds advance both in lockstep
+            self._draft_admit(slot, stream.prompt[:m])
         self._slots[slot] = stream
-        self._tok[slot] = int(tok0[0])
-        self._pos[slot] = n
-        self._keys[slot] = np.asarray(keys1[0])
         self._temps[slot] = stream.temperature
         now = time.monotonic()
-        stream._emit(int(tok0[0]), now)
+        delivered = False
+        if tok0 is not None:
+            self._tok[slot] = tok0
+            self._pos[slot] = n
+            self._keys[slot] = key1
+            delivered = stream._deliver(tok0, now)
+        else:
+            # longest-prefix match: next decode steps consume the
+            # unmatched prompt tokens; the stream's key stays unsplit
+            # until the first REAL sample (the step that consumes the
+            # last prompt token), so tokens match a cold prefill
+            self._tok[slot] = int(stream.prompt[m])
+            self._pos[slot] = m
+            self._keys[slot] = stream.key
+            self._feed[slot] = [int(x) for x in stream.prompt[m + 1:]]
         with self._cv:
-            self._admitted += 1
+            if not stream._counted_admit:
+                stream._counted_admit = True
+                self._admitted += 1
             self._active += 1
-            self._tokens_total += 1
-            self._recent_tokens.append((now, 1))
-            ttft = stream.ttft_s
-            self._ttfts.append(ttft)
-            h = self._ttft_hist
-            h["sum"] += ttft
-            h["count"] += 1
-            for i, bound in enumerate(LATENCY_BUCKETS_S):
-                if ttft <= bound:
-                    h["counts"][i] += 1
-                    break
-            else:
-                h["inf"] += 1
-        if stream.tokens_emitted >= stream.max_new:
+            if delivered:
+                self._tokens_total += 1
+                self._recent_tokens.append((now, 1))
+                self._record_ttft_locked(stream)
+        if tok0 is not None and stream.tokens_emitted >= stream.max_new:
             self._release_slot(slot, stream)
+
+    def _record_ttft_locked(self, stream: GenerationStream) -> None:
+        """TTFT bookkeeping for a stream's FIRST emitted token (caller
+        holds `_cv`)."""
+        ttft = stream.ttft_s
+        self._ttfts.append(ttft)
+        h = self._ttft_hist
+        h["sum"] += ttft
+        h["count"] += 1
+        for i, bound in enumerate(LATENCY_BUCKETS_S):
+            if ttft <= bound:
+                h["counts"][i] += 1
+                break
+        else:
+            h["inf"] += 1
+
+    def _scatter_row(self, slot: int, row, pages: Optional[List[int]]):
+        """Scatter a B=1 row state (device or host tree) into the slot
+        table: dense rows in one eager tree scatter; paged rows copy the
+        dense K/V into the freshly allocated physical pages, recurrent
+        carries per slot."""
+        import jax
+
+        if not self.paged:
+            self._state = jax.tree_util.tree_map(
+                lambda tbl, r: tbl.at[slot].set(r[0]), self._state, row)
+            return
+        ps = self.page_size
+        new_state = []
+        for i, lay in enumerate(self._state):
+            if not lay:
+                new_state.append(lay)
+            elif "h" in lay:
+                new_state.append(
+                    {"h": lay["h"].at[slot].set(row[i]["h"][0]),
+                     "c": lay["c"].at[slot].set(row[i]["c"][0])})
+            else:
+                k, v = lay["k"], lay["v"]
+                rk, rv = row[i]["k"][0], row[i]["v"][0]
+                for j, phys in enumerate(pages):
+                    blk_k = rk[j * ps: (j + 1) * ps]
+                    blk_v = rv[j * ps: (j + 1) * ps]
+                    k = k.at[phys, : blk_k.shape[0]].set(blk_k)
+                    v = v.at[phys, : blk_v.shape[0]].set(blk_v)
+                new_state.append({"k": k, "v": v})
+        self._state = tuple(new_state)
+        self._page_table[slot, :] = 0
+        self._page_table[slot, : len(pages)] = pages
+
+    def _draft_admit(self, slot: int, prompt: np.ndarray) -> None:
+        """Prefill the draft model's slot row over `prompt` (the tokens
+        the target row has consumed).  The draft decodes greedily with a
+        dummy key — its proposals only gate acceptance, never sampling."""
+        import jax
+
+        dn = self.draft_net
+        m = int(prompt.shape[0])
+        bucket = self._prompt_bucket(m)
+        pb = np.zeros((1, bucket), np.int32)
+        pb[0, :m] = prompt
+        row = dn.infer_cache.init_decode_state(dn.conf, 1, self.max_seq)
+        _, _, row = dn.infer_cache.prefill(
+            dn.conf, dn.params, row, pb, np.asarray([m], np.int32),
+            np.zeros((1, 2), np.uint32), np.zeros((1,), np.float32))
+        self._draft_state = jax.tree_util.tree_map(
+            lambda tbl, r: tbl.at[slot].set(r[0]), self._draft_state, row)
+
+    # -- prefix cache -------------------------------------------------------
+    def _prefix_digest(self, prompt: np.ndarray) -> str:
+        """Cache key for a prompt's prefill: prompt tokens + conf
+        fingerprint + max_seq (row-state shape) + serve policy — the
+        same dimensions that key the prefill program itself."""
+        ic = self.net.infer_cache
+        h = hashlib.sha256()
+        h.update(ic._fingerprint(self.net.conf).encode())
+        h.update(repr((self.max_seq, ic.policy)).encode())
+        h.update(np.ascontiguousarray(prompt, np.int32).tobytes())
+        return h.hexdigest()
+
+    def _prefix_store(self, prompt: np.ndarray, logp: np.ndarray,
+                      row) -> None:
+        """Record a cold prefill: (prompt, logp at its last position,
+        host copy of the filled B=1 row state), LRU-capped in memory and
+        written through to the program disk store when one is attached."""
+        import jax
+
+        host_row = jax.tree_util.tree_map(np.asarray, row)
+        digest = self._prefix_digest(prompt)
+        entry = (np.asarray(prompt, np.int32).copy(), logp, host_row)
+        with self._cv:
+            self._prefix_lru[digest] = entry
+            self._prefix_lru.move_to_end(digest)
+            while len(self._prefix_lru) > PREFIX_CACHE_ENTRIES:
+                self._prefix_lru.popitem(last=False)
+        persist = self.net.infer_cache.persist
+        if persist is not None:
+            try:
+                arrs = {"prompt": entry[0], "logp": logp}
+                for i, lay in enumerate(host_row):
+                    for kk, vv in lay.items():
+                        arrs[f"L{i}_{kk}"] = vv
+                buf = io.BytesIO()
+                np.savez(buf, **arrs)
+                persist.store_bytes(("prefix", digest), buf.getvalue())
+            except BaseException:  # noqa: BLE001 — disk is best-effort
+                pass
+
+    def _prefix_disk_load(self, digest: str):
+        """Exact-match entry from the disk store, or None.  Corruption
+        surfaces as an exception and becomes a counted miss upstream."""
+        persist = self.net.infer_cache.persist
+        if persist is None:
+            return None
+        blob = persist.load_bytes(("prefix", digest))
+        if blob is None:
+            return None
+        z = np.load(io.BytesIO(blob))
+        row = []
+        for i in range(len(self._layer_types)):
+            lay = {}
+            for kk in ("c", "h", "k", "v"):
+                name = f"L{i}_{kk}"
+                if name in z:
+                    lay[kk] = z[name]
+            row.append(lay)
+        entry = (np.asarray(z["prompt"], np.int32),
+                 np.asarray(z["logp"], np.float32), tuple(row))
+        with self._cv:
+            self._prefix_lru[digest] = entry
+            while len(self._prefix_lru) > PREFIX_CACHE_ENTRIES:
+                self._prefix_lru.popitem(last=False)
+        return entry
+
+    def _prefix_lookup(self, prompt: np.ndarray):
+        """(matched_tokens, logp_or_None, host_row_state) for `prompt`,
+        or None on a miss.  logp is set only for an exact match.  ANY
+        failure — the armed `generate.prefix_lookup` fault, a corrupt
+        disk entry — degrades to a counted miss and a cold prefill; the
+        stream never fails here."""
+        try:
+            faults.fire("generate.prefix_lookup",
+                        prompt_tokens=int(prompt.shape[0]))
+            digest = self._prefix_digest(prompt)
+            with self._cv:
+                entry = self._prefix_lru.get(digest)
+                if entry is not None:
+                    self._prefix_lru.move_to_end(digest)
+            if entry is None:
+                entry = self._prefix_disk_load(digest)
+            if entry is not None:
+                with self._cv:
+                    self._prefix_hits += 1
+                return (int(entry[0].shape[0]), entry[1], entry[2])
+            if self.prefix_match == "longest":
+                best = None
+                with self._cv:
+                    candidates = list(self._prefix_lru.values())
+                for p2, _, row2 in candidates:
+                    m = int(p2.shape[0])
+                    if (m < int(prompt.shape[0])
+                            and (best is None or m > best[0])
+                            and np.array_equal(p2, prompt[:m])):
+                        best = (m, None, row2)
+                if best is not None:
+                    with self._cv:
+                        self._prefix_hits += 1
+                    return best
+        except BaseException:  # noqa: BLE001 — lookup faults degrade
+            pass
+        with self._cv:
+            self._prefix_misses += 1
+        return None
 
     def _release_slot(self, slot: int,
                       stream: GenerationStream,
@@ -744,12 +1155,42 @@ class ContinuousBatcher:
         stream._finish(error)
         self._slots[slot] = None
         self._temps[slot] = 0.0
+        self._feed[slot] = []
+        if self.paged:
+            # release the slot's pages and point its table rows at the
+            # scratch page so later junk writes stay inert
+            self._pool.free(self._page_table[slot])
+            self._page_table[slot, :] = 0
         with self._cv:
             self._active -= 1
             if error is None:
                 self._completed += 1
             else:
                 self._failed += 1
+            self._cv.notify_all()
+
+    def _preempt_slot(self, slot: int,
+                      stream: GenerationStream) -> None:
+        """Page-pool preemption (overcommitted pool, mid-decode
+        exhaustion): free the slot AND its pages WITHOUT finishing the
+        stream, and requeue it at the front for recompute-from-scratch.
+        The readmitted stream replays its already-delivered tokens
+        silently (see `GenerationStream._deliver`), so the consumer
+        sees one uninterrupted, token-identical stream.  Freeing this
+        slot's pages is also what guarantees progress: the survivors
+        can now grow to full length, and `n_pages >= pages_per_slot`
+        (enforced at construction) means a lone stream always fits."""
+        stream._replay = stream.tokens_emitted
+        self._slots[slot] = None
+        self._temps[slot] = 0.0
+        self._feed[slot] = []
+        if self.paged:
+            self._pool.free(self._page_table[slot])
+            self._page_table[slot, :] = 0
+        with self._cv:
+            self._active -= 1
+            self._preempted += 1
+            self._pending.appendleft(stream)
             self._cv.notify_all()
 
     def _admit_pending(self) -> None:
@@ -763,15 +1204,53 @@ class ContinuousBatcher:
                 stream = self._pending.popleft()
             try:
                 self._admit_one(slot, stream)
+            except PagesExhausted:
+                # genuine pool pressure: queue, don't fail — pages free
+                # as live streams complete, and admission re-runs every
+                # table step
+                with self._cv:
+                    self._pending.appendleft(stream)
+                return
             except BaseException as e:  # noqa: BLE001 — isolate the stream
                 with self._cv:
                     self._failed += 1
                 stream._finish(e)
 
+    def _lazy_alloc(self, k: int) -> None:
+        """Ensure every active slot has physical pages for its next `k`
+        positions, allocating from the pool as streams cross page
+        boundaries.  Genuine exhaustion past the admission gate
+        (overcommit pressure) preempts the ONE stream that could not
+        grow — requeued for recompute, never failed; an armed
+        `decode.page_alloc` fault ends that stream with the injected
+        error.  Either way the table keeps decoding."""
+        ps = self.page_size
+        for slot, stream in enumerate(self._slots):
+            if stream is None:
+                continue
+            pos = int(self._pos[slot])
+            need = [p for p in range(pos // ps, (pos + k - 1) // ps + 1)
+                    if p < self.pages_per_slot
+                    and self._page_table[slot, p] == 0]
+            if not need:
+                continue
+            try:
+                got = self._pool.alloc(len(need), slot=slot, pos=pos)
+            except PagesExhausted:
+                self._preempt_slot(slot, stream)
+                continue
+            except BaseException as e:  # noqa: BLE001 — isolate the stream
+                self._release_slot(slot, stream, error=e)
+                continue
+            for p, phys in zip(need, got):
+                self._page_table[slot, p] = phys
+
     def _decode_once(self) -> None:
         """One table step: fire per-slot fault points (a raise ends THAT
         stream only), then one compiled decode call over all slots, then
-        emit per-slot tokens and free finished slots."""
+        emit per-slot tokens and free finished slots.  When speculative
+        decoding is on and every active slot has room for a spec_k
+        chunk, the step is a draft+verify round instead."""
         for slot, stream in enumerate(self._slots):
             if stream is None:
                 continue
@@ -780,13 +1259,39 @@ class ContinuousBatcher:
                             pos=int(self._pos[slot]))
             except BaseException as e:  # noqa: BLE001 — isolate the stream
                 self._release_slot(slot, stream, error=e)
-        if not any(s is not None for s in self._slots):
+        active = [s for s, st in enumerate(self._slots) if st is not None]
+        if not active:
+            return
+        if (self.spec_k
+                and all(not self._feed[s] for s in active)
+                and all(int(self._pos[s]) + self.spec_k <= self.max_seq
+                        for s in active)):
+            self._spec_once()
             return
         ic = self.net.infer_cache
-        tok2, keys2, self._state = ic.decode(
-            self.net.conf, self.net.params, self._state,
-            self._tok.copy(), self._pos.copy(), self._keys.copy(),
-            self._temps.copy())
+        if self.paged:
+            self._lazy_alloc(1)
+            if not any(s is not None for s in self._slots):
+                return
+            tok2, keys2, self._state = ic.decode_paged(
+                self.net.conf, self.net.params, self._state,
+                self._tok.copy(), self._pos.copy(), self._keys.copy(),
+                self._temps.copy(), self._page_table.copy())
+        else:
+            tok2, keys2, self._state = ic.decode(
+                self.net.conf, self.net.params, self._state,
+                self._tok.copy(), self._pos.copy(), self._keys.copy(),
+                self._temps.copy())
+        if self.draft_net is not None:
+            # non-spec rounds (feeds pending, or a slot near the table
+            # edge) still advance the draft's carries over the same
+            # token, so the draft stays in lockstep with what each slot
+            # has consumed
+            dn = self.draft_net
+            _, _, self._draft_state = dn.infer_cache.decode(
+                dn.conf, dn.params, self._draft_state, self._tok.copy(),
+                self._pos.copy(), np.zeros((self.n_slots, 2), np.uint32),
+                np.zeros((self.n_slots,), np.float32))
         tok2 = np.asarray(tok2)
         keys2 = np.asarray(keys2)
         now = time.monotonic()
@@ -794,11 +1299,23 @@ class ContinuousBatcher:
         for slot, stream in enumerate(self._slots):
             if stream is None:
                 continue
+            if self._feed[slot]:
+                # prompt-feed step (longest-prefix admission): the
+                # table consumed one prompt token; the sampled output
+                # and advanced key are discarded so the stream's key
+                # stream stays identical to a cold prefill's
+                self._tok[slot] = self._feed[slot].pop(0)
+                self._pos[slot] += 1
+                continue
+            first = stream.tokens_emitted == 0
             self._tok[slot] = tok2[slot]
             self._pos[slot] += 1
             self._keys[slot] = keys2[slot]
-            stream._emit(int(tok2[slot]), now)
-            emitted += 1
+            if stream._deliver(int(tok2[slot]), now):
+                emitted += 1
+                if first:
+                    with self._cv:
+                        self._record_ttft_locked(stream)
             if (stream.tokens_emitted >= stream.max_new
                     or int(self._pos[slot]) >= self.max_seq):
                 self._release_slot(slot, stream)
@@ -808,6 +1325,113 @@ class ContinuousBatcher:
             while (self._recent_tokens
                    and now - self._recent_tokens[0][0] > RATE_WINDOW_S):
                 self._recent_tokens.popleft()
+
+    def _spec_once(self) -> None:
+        """One speculative round: the draft proposes spec_k - 1 tokens
+        per slot, ONE verify program chain-samples spec_k target tokens
+        against them, and each slot emits its agreeing prefix (>= 1
+        token — position 0 consumes the slot's current token, whose
+        sample needs no draft to agree with).
+
+        Parity: emitted tokens are the target's own chain samples, and
+        sample i conditioned on exactly the tokens emitted before it —
+        the acceptance rule cuts the chain at the first draft
+        disagreement, which is precisely where sample i+1's conditioning
+        would diverge from the emitted sequence.  The key stream advances
+        once per ACCEPTED token (keys_all[:, e-1]), so trajectories
+        match sequential decode at any temperature.  Draft carries roll
+        back to the retained copy at each slot's accepted depth;
+        mis-speculated K/V rows are rewritten before the next read."""
+        import jax
+        import jax.numpy as jnp
+
+        ic = self.net.infer_cache
+        dn = self.draft_net
+        k = self.spec_k
+        nb = self.n_slots
+        dkeys = np.zeros((nb, 2), np.uint32)
+        dtemps = np.zeros((nb,), np.float32)
+        toks = np.zeros((nb, k), np.int32)
+        toks[:, 0] = self._tok
+        # draft phase: k - 1 proposals plus one catch-up step (so the
+        # retained ladder reaches depth k for fully accepted chunks);
+        # each call's input state is copied first because decode donates
+        retained = [self._draft_state]
+        cur = self._tok.copy()
+        for i in range(1, k + 1):
+            feed = jax.tree_util.tree_map(jnp.copy, retained[-1])
+            nxt, _, out = dn.infer_cache.decode(
+                dn.conf, dn.params, feed, cur,
+                self._pos + np.int32(i - 1), dkeys, dtemps)
+            retained.append(out)
+            cur = np.asarray(nxt)
+            if i < k:
+                toks[:, i] = cur
+        if self.paged:
+            self._lazy_alloc(k)
+            if not any(s is not None for s in self._slots):
+                return
+            g, keys_all, self._state = ic.verify_paged(
+                self.net.conf, self.net.params, self._state, toks,
+                self._pos.copy(), self._keys.copy(), self._temps.copy(),
+                self._page_table.copy())
+        else:
+            g, keys_all, self._state = ic.verify(
+                self.net.conf, self.net.params, self._state, toks,
+                self._pos.copy(), self._keys.copy(), self._temps.copy())
+        g = np.asarray(g)
+        keys_all = np.asarray(keys_all)
+        now = time.monotonic()
+        e_idx = np.zeros((nb,), np.int32)
+        emitted = 0
+        accepted: List[int] = []
+        for slot, stream in enumerate(self._slots):
+            if stream is None:
+                continue
+            e = 1
+            while e < k and toks[slot, e] == g[slot, e - 1]:
+                e += 1
+            e_idx[slot] = e
+            first = stream.tokens_emitted == 0
+            sent = 0
+            for j in range(e):
+                if stream.tokens_emitted >= stream.max_new:
+                    break  # surplus accepted tokens past the budget
+                if stream._deliver(int(g[slot, j]), now):
+                    sent += 1
+            emitted += sent
+            accepted.append(sent)
+            self._tok[slot] = g[slot, e - 1]
+            self._keys[slot] = keys_all[slot, e - 1]
+            self._pos[slot] += e
+            if first and sent:
+                with self._cv:
+                    self._record_ttft_locked(stream)
+            if (stream.tokens_emitted >= stream.max_new
+                    or int(self._pos[slot]) >= self.max_seq):
+                self._release_slot(slot, stream)
+        # roll each draft carry to the retained state at its slot's
+        # accepted depth (inactive slots keep depth 0 = unchanged)
+        rows = jnp.arange(nb)
+        self._draft_state = jax.tree_util.tree_map(
+            lambda *leaves: jnp.stack(leaves)[e_idx, rows], *retained)
+        with self._cv:
+            self._spec_rounds += 1
+            self._tokens_total += emitted
+            self._recent_tokens.append((now, emitted))
+            while (self._recent_tokens
+                   and now - self._recent_tokens[0][0] > RATE_WINDOW_S):
+                self._recent_tokens.popleft()
+            for c in accepted:
+                h = self._accept_hist
+                h["sum"] += c
+                h["count"] += 1
+                for i, bound in enumerate(ACCEPTED_TOKENS_BOUNDS):
+                    if c <= bound:
+                        h["counts"][i] += 1
+                        break
+                else:
+                    h["inf"] += 1
 
     def _decode_loop(self) -> None:
         while True:
@@ -862,5 +1486,56 @@ class ContinuousBatcher:
                     "count": h["count"],
                 },
             }
+        if self.paged:
+            with self._cv:
+                live_tokens = sum(
+                    int(self._pos[s]) for s, st in enumerate(self._slots)
+                    if st is not None)
+                out["kv_pages"] = {
+                    "page_size": self.page_size,
+                    "total": self.n_pages,
+                    "free": self._pool.free_count,
+                    "live": self._pool.live_count,
+                    "live_tokens": live_tokens,
+                    "live_bytes": self._pool.live_count * self._page_bytes(),
+                    "preempted_streams": self._preempted,
+                }
+        if self.prefix_cache_enabled:
+            with self._cv:
+                out["prefix_cache"] = {
+                    "hits": self._prefix_hits,
+                    "misses": self._prefix_misses,
+                    "entries": len(self._prefix_lru),
+                    "match": self.prefix_match,
+                }
+        if self.spec_k:
+            with self._cv:
+                h = self._accept_hist
+                out["speculative"] = {
+                    "k": self.spec_k,
+                    "rounds": self._spec_rounds,
+                    "accepted_per_step": (round(h["sum"] / h["count"], 3)
+                                          if h["count"] else 0.0),
+                    "accepted_hist": {
+                        "bounds": list(ACCEPTED_TOKENS_BOUNDS),
+                        "counts": list(h["counts"]),
+                        "inf": h["inf"],
+                        "sum": h["sum"],
+                        "count": h["count"],
+                    },
+                }
         out["fresh_compiles"] = self.net.infer_cache.stats.misses
+        if self.draft_net is not None:
+            # warmed means warmed END TO END: the draft's programs count
+            out["fresh_compiles"] += self.draft_net.infer_cache.stats.misses
         return out
+
+    def _page_bytes(self) -> int:
+        """Bytes one physical K/V page occupies across every attention
+        layer (K and V)."""
+        total = 0
+        for lay in (self._state or ()):
+            if lay and "k" in lay and "h" not in lay:
+                total += 2 * self.page_size * int(np.prod(
+                    lay["k"].shape[2:])) * lay["k"].dtype.itemsize
+        return total

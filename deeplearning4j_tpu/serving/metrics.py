@@ -66,6 +66,10 @@ FAMILIES = {
     "dl4j_serving_tokens_total": ("counter", ()),
     "dl4j_serving_ttft_seconds": ("histogram", ()),
     "dl4j_serving_decode_slots": ("gauge", ("state",)),
+    "dl4j_serving_kv_pages": ("gauge", ("state",)),
+    "dl4j_serving_prefix_cache_hits_total": ("counter", ()),
+    "dl4j_serving_prefix_cache_misses_total": ("counter", ()),
+    "dl4j_serving_accepted_tokens_per_step": ("histogram", ()),
     "dl4j_router_ready": ("gauge", ()),
     "dl4j_router_inflight": ("gauge", ()),
     "dl4j_router_replicas_healthy": ("gauge", ()),
@@ -302,6 +306,32 @@ def replica_metrics(stats: dict, page: Optional[PrometheusText] = None,
         p.gauge("dl4j_serving_decode_slots",
                 "Decode slot-table occupancy (by state).",
                 slots.get("free", 0), lbl(state="free"))
+        pages = gen.get("kv_pages")
+        if pages:
+            p.gauge("dl4j_serving_kv_pages",
+                    "Paged KV-cache page-pool occupancy (by state).",
+                    pages.get("free", 0), lbl(state="free"))
+            p.gauge("dl4j_serving_kv_pages",
+                    "Paged KV-cache page-pool occupancy (by state).",
+                    pages.get("live", 0), lbl(state="live"))
+        prefix = gen.get("prefix_cache")
+        if prefix:
+            p.counter("dl4j_serving_prefix_cache_hits_total",
+                      "Stream admissions that reused cached prefill "
+                      "state (prefix-cache hits).",
+                      prefix.get("hits", 0), lbl())
+            p.counter("dl4j_serving_prefix_cache_misses_total",
+                      "Stream admissions that ran a cold prefill "
+                      "(prefix-cache misses).",
+                      prefix.get("misses", 0), lbl())
+        spec = gen.get("speculative")
+        h = (spec or {}).get("accepted_hist")
+        if h and h.get("count"):
+            p.histogram("dl4j_serving_accepted_tokens_per_step",
+                        "Tokens accepted per speculative verify step "
+                        "(draft proposals plus the guaranteed target "
+                        "token).", h["bounds"], h["counts"], h["inf"],
+                        h["sum"], h["count"], lbl())
     return p.render() if own_page else ""
 
 

@@ -266,7 +266,11 @@ class ModelServer:
                  generate: bool = False, gen_slots: int = 4,
                  gen_max_seq: int = 64,
                  gen_prompt_buckets=(8,),
-                 gen_max_pending: int = 64):
+                 gen_max_pending: int = 64,
+                 gen_page_size: int = 0, gen_pages: int = 0,
+                 gen_prefix_cache: bool = False,
+                 gen_prefix_match: str = "exact",
+                 gen_draft=None, gen_spec_k: int = 0):
         self.net = net
         self.batching = bool(batching)
         self.request_timeout_s = float(request_timeout_s)
@@ -283,7 +287,13 @@ class ModelServer:
             ContinuousBatcher(net, n_slots=gen_slots, max_seq=gen_max_seq,
                               prompt_buckets=gen_prompt_buckets,
                               max_pending=gen_max_pending,
-                              auto_start=False)
+                              auto_start=False,
+                              page_size=gen_page_size,
+                              n_pages=gen_pages,
+                              prefix_cache=gen_prefix_cache,
+                              prefix_match=gen_prefix_match,
+                              draft_net=gen_draft,
+                              spec_k=gen_spec_k)
             if generate else None)
         handler = type("Handler", (_ServeHandler,), {"model_server": self})
         self.server = ThreadingHTTPServer((host, port), handler)

@@ -212,6 +212,66 @@ def test_generation_metrics_conformance_and_monotonic(tmp_path):
         server.stop()
 
 
+def test_decode_accelerator_metrics_conformance_and_monotonic():
+    """The ISSUE 16 families — KV page-pool gauge, prefix-cache hit and
+    miss counters, accepted-tokens-per-step histogram — render to
+    strictly-parseable text with exactly the declared label sets, the
+    counters only move up across scrapes, and the pre-existing
+    generation families keep their label sets untouched."""
+    from deeplearning4j_tpu.models.zoo import char_lstm
+
+    net = MultiLayerNetwork(char_lstm(11, hidden=12, n_layers=1),
+                            seed=0).init()
+    draft = MultiLayerNetwork(char_lstm(11, hidden=8, n_layers=1),
+                              seed=1).init()
+    net.warmup_generate(slots=2, max_seq=16, prompt_buckets=(8,),
+                        page_size=4, prefix_cache=True, draft_net=draft,
+                        spec_k=2)
+    server = net.serve(generate=True, gen_slots=2, gen_max_seq=16,
+                       gen_prompt_buckets=(8,), gen_page_size=4,
+                       gen_prefix_cache=True, gen_draft=draft,
+                       gen_spec_k=2)
+    try:
+        _http(server.url + "/v1/generate",
+              {"prompt": [1, 2], "max_new_tokens": 4})
+        code, text1 = _http(server.url + "/metrics")
+        assert code == 200
+        parsed1 = parse_prometheus_text(text1)  # raises on any bad line
+        for family in ("dl4j_serving_kv_pages",
+                       "dl4j_serving_prefix_cache_hits_total",
+                       "dl4j_serving_prefix_cache_misses_total",
+                       "dl4j_serving_accepted_tokens_per_step_bucket",
+                       "dl4j_serving_accepted_tokens_per_step_count"):
+            assert family in parsed1, family
+        # the page gauge carries the state label, both states present
+        states = {dict(lbl).get("state")
+                  for lbl in parsed1["dl4j_serving_kv_pages"]}
+        assert states == {"free", "live"}
+        # the pre-existing slot gauge kept its exact label set
+        assert {dict(lbl).get("state")
+                for lbl in parsed1["dl4j_serving_decode_slots"]} == {
+                    "active", "free"}
+        misses1 = list(
+            parsed1["dl4j_serving_prefix_cache_misses_total"].values())[0]
+        assert misses1 >= 1  # the cold first prompt
+        # the same prompt again: a prefix hit, and every counter and
+        # cumulative histogram series only moved up
+        _http(server.url + "/v1/generate",
+              {"prompt": [1, 2], "max_new_tokens": 4})
+        code, text2 = _http(server.url + "/metrics")
+        parsed2 = parse_prometheus_text(text2)
+        _assert_monotonic(parsed1, parsed2)
+        assert list(
+            parsed2["dl4j_serving_prefix_cache_hits_total"].values())[0] >= 1
+        assert (list(
+            parsed2["dl4j_serving_accepted_tokens_per_step_count"].values()
+        )[0] >= list(
+            parsed1["dl4j_serving_accepted_tokens_per_step_count"].values()
+        )[0])
+    finally:
+        server.stop()
+
+
 def test_parser_rejects_malformed_lines():
     with pytest.raises(ValueError):
         parse_prometheus_text("this is not a metric line\n")
