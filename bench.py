@@ -1967,6 +1967,131 @@ def bench_generate_accel(devs) -> None:
 
 
 # ---------------------------------------------------------------------------
+# tp_serve — 1-D (replicated params) vs 2-D tensor-parallel serving
+# ---------------------------------------------------------------------------
+
+_TP_SERVE_CHILD = r"""
+import json, time
+import numpy as np
+import jax
+from deeplearning4j_tpu.models.zoo import char_transformer
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.serving.batcher import ContinuousBatcher
+
+SMALL = %(small)r
+if SMALL:
+    vocab, d_model, blocks, heads, seq = 32, 32, 2, 4, 32
+    rows, iters, n_new, slots = 16, 3, 8, 2
+else:
+    vocab, d_model, blocks, heads, seq = 64, 128, 2, 8, 64
+    rows, iters, n_new, slots = 64, 10, 24, 4
+conf = char_transformer(vocab, d_model=d_model, n_blocks=blocks,
+                        n_heads=heads, max_seq_len=seq)
+out = {"devices": jax.device_count()}
+for tag, spec in (("1d", "batch=8"), ("2d", "batch=2,model=4")):
+    net = MultiLayerNetwork(conf, seed=0).init()
+    net.set_serve_mesh(spec=spec)
+    rng = np.random.RandomState(0)
+    x = rng.randint(1, vocab, size=(rows, 16)).astype(np.int32)
+    jax.block_until_ready(net.output(x))  # compile outside the window
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        y = net.output(x)
+    jax.block_until_ready(y)
+    serve_rps = rows * iters / (time.perf_counter() - t0)
+    net.warmup_generate(slots=slots, max_seq=seq, prompt_buckets=(8,))
+    cb = ContinuousBatcher(net, n_slots=slots, max_seq=seq,
+                           prompt_buckets=(8,))
+    try:
+        t0 = time.perf_counter()
+        streams = [cb.submit([1 + i, 2, 3], max_new_tokens=n_new)
+                   for i in range(slots)]
+        toks = [list(s.tokens(timeout=240.0)) for s in streams]
+        dt = time.perf_counter() - t0
+    finally:
+        cb.stop()
+    mem = {}
+    for row in net.infer_cache.program_memory():
+        e = row["entry"]
+        if e in ("output", "decode") and e not in mem:
+            mem[e] = {"per_device": row["per_device_argument_bytes"],
+                      "replicated": row["replicated_argument_bytes"],
+                      "analysis": row["memory_analysis"]}
+    out[tag] = {"serve_rows_per_sec": serve_rps,
+                "decode_tokens_per_sec": sum(map(len, toks))
+                / max(dt, 1e-9),
+                "tokens": sum(map(len, toks)), "mem": mem}
+print("TPRESULT " + json.dumps(out), flush=True)
+"""
+
+
+def bench_tp_serve(devs) -> None:
+    """Tensor-parallel serving (ISSUE 17): 1-D Mesh(('batch',)) with
+    replicated params vs the 2-D ('batch','model') ShardPlan on the
+    SAME transformer — serve rows/sec, decode tokens/sec, and the
+    per-chip argument bytes `program_memory()` attributes to each plan
+    (the pair that proves a model-sharded plan fits where a replicated
+    one cannot).  Runs in a child forced to 8 host-CPU devices so the
+    collectives are real regardless of what this process claimed —
+    every line is tagged cpu_fallback because those numbers are NOT
+    accelerator numbers (collective cost on host CPU is a different
+    regime; the memory split, however, is backend-independent)."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "")
+                        + " --xla_force_host_platform_device_count=8")
+    env["PYTHONPATH"] = (os.path.dirname(os.path.abspath(__file__))
+                         + os.pathsep + env.get("PYTHONPATH", ""))
+    proc = subprocess.run(
+        [sys.executable, "-c", _TP_SERVE_CHILD % {"small": SMALL}],
+        env=env, capture_output=True, text=True,
+        timeout=PER_BENCH_BUDGET_S - 10)
+    line = next((ln for ln in proc.stdout.splitlines()
+                 if ln.startswith("TPRESULT ")), None)
+    if line is None:
+        raise RuntimeError(f"tp_serve child produced no result: "
+                           f"{proc.stderr[-2000:]}")
+    res = json.loads(line[len("TPRESULT "):])
+    d1, d2 = res["1d"], res["2d"]
+    note = ("8 forced host-CPU devices; vs_baseline = 2-D / 1-D on "
+            "identical work (host-CPU collectives, NOT an accelerator "
+            "number)")
+    _emit("tp-serve 1-D rows/sec", d1["serve_rows_per_sec"], "rows/sec",
+          None, backend="cpu_fallback", mesh="batch=8",
+          baseline_note="1-D control arm: rows split, params replicated")
+    _emit("tp-serve 2-D rows/sec", d2["serve_rows_per_sec"], "rows/sec",
+          d2["serve_rows_per_sec"] / max(d1["serve_rows_per_sec"], 1e-9),
+          backend="cpu_fallback", mesh="batch=2,model=4",
+          baseline_note=note)
+    _emit("tp-serve 1-D decode tokens/sec", d1["decode_tokens_per_sec"],
+          "tokens/sec", None, backend="cpu_fallback", mesh="batch=8",
+          tokens=d1["tokens"],
+          baseline_note="1-D control arm: decode state replicated")
+    _emit("tp-serve 2-D decode tokens/sec", d2["decode_tokens_per_sec"],
+          "tokens/sec",
+          d2["decode_tokens_per_sec"]
+          / max(d1["decode_tokens_per_sec"], 1e-9),
+          backend="cpu_fallback", mesh="batch=2,model=4",
+          tokens=d2["tokens"], baseline_note=note)
+    for entry in ("output", "decode"):
+        m1 = d1["mem"].get(entry)
+        m2 = d2["mem"].get(entry)
+        if not (m1 and m2):
+            continue
+        _emit(f"tp-serve {entry} per-chip argument bytes",
+              m2["per_device"], "bytes",
+              m1["per_device"] / max(m2["per_device"], 1),
+              backend="cpu_fallback", mesh="batch=2,model=4",
+              replicated_bytes=m2["replicated"],
+              one_d_per_device_bytes=m1["per_device"],
+              memory_analysis=m2["analysis"],
+              baseline_note="vs_baseline = 1-D per-chip bytes / 2-D "
+                            "per-chip bytes (the model-axis shrink); "
+                            "memory_analysis attached when the backend "
+                            "exposes compiled.memory_analysis()")
+
+
+# ---------------------------------------------------------------------------
 
 # BASELINE.json configs[0..4] first, heavyweight extras after — a degraded
 # (timeout-shortened) run still captures the five baseline metrics.
@@ -1974,7 +2099,8 @@ BENCHES = [bench_lenet, bench_char_lstm, bench_vgg_cifar10, bench_word2vec,
            bench_dp_allreduce,
            bench_elastic_resume,
            bench_char_lstm4, bench_step_cache, bench_infer_latency,
-           bench_serve, bench_serve_precision, bench_serve_router,
+           bench_serve, bench_serve_precision, bench_tp_serve,
+           bench_serve_router,
            bench_fleet_slo, bench_generate, bench_generate_accel,
            bench_prefetch,
            bench_cold_start, bench_north_star_cli,

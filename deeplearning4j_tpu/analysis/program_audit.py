@@ -53,6 +53,13 @@ invariants:
                          original per-batch-recompile sin PR 1 fixed;
                          big consts also poison the persistent cache —
                          the artifact embeds the data)
+  replicated-large-leaf  a program compiled on a mesh WITH a `model`
+                         axis that still places a >= threshold-byte
+                         param leaf fully replicated — the "forgot to
+                         shard the embedding" bug: the tensor-parallel
+                         plan exists to split exactly these leaves, and
+                         a replicated one silently re-caps per-chip
+                         memory at the single-chip bound
 
 Programs reach the auditor three ways: `audit_fn` traces any callable,
 `audit_cache` walks the audit records a `CompiledProgramCache` keeps
@@ -85,6 +92,10 @@ POLICY_WIDTH = {"f32": 32, "bf16": 16, "int8": 16}
 
 #: default byte threshold above which a folded constant is flagged
 CONST_BYTES_THRESHOLD = 1 << 20  # 1 MiB
+
+#: default byte threshold above which a fully-replicated param leaf on a
+#: model-axis mesh is flagged (replicated-large-leaf)
+REPLICATED_LEAF_BYTES = 1 << 20  # 1 MiB
 
 #: default sequence scale for the materialized-scores rule: only shapes
 #: with two dims at or above this count as an [S,S] materialization
@@ -279,9 +290,74 @@ def _donation_expected(expect_donation: Optional[bool]) -> bool:
     return default_backend() != "cpu"
 
 
+def _spec_axes(sharding) -> set:
+    """Mesh axis names a NamedSharding's PartitionSpec actually uses
+    (parts may be a name, a tuple of names, or None)."""
+    spec = getattr(sharding, "spec", None)
+    axes = set()
+    for part in (spec or ()):
+        if part is None:
+            continue
+        for a in (part if isinstance(part, tuple) else (part,)):
+            axes.add(a)
+    return axes
+
+
+def _sharding_leaves(shardings) -> list:
+    """Every `jax.sharding.Sharding` in a per-arg shardings tuple (each
+    entry is one Sharding for the whole arg or a pytree of them)."""
+    import jax
+
+    out = []
+    for entry in (shardings or ()):
+        out.extend(jax.tree_util.tree_leaves(
+            entry,
+            is_leaf=lambda x: isinstance(x, jax.sharding.Sharding)))
+    return [s for s in out if isinstance(s, jax.sharding.Sharding)]
+
+
+def _replicated_large_leaves(rec, where: str, threshold: int
+                             ) -> List[Finding]:
+    """The replicated-large-leaf rule body: on a mesh whose shardings
+    mention a `model` axis, every abstract-arg leaf >= threshold bytes
+    must shard over it."""
+    import jax
+    import numpy as np
+
+    mesh_axes = set()
+    for s in _sharding_leaves(rec.get("shardings")):
+        mesh = getattr(s, "mesh", None)
+        if mesh is not None:
+            mesh_axes.update(mesh.axis_names)
+    if "model" not in mesh_axes:
+        return []
+    findings: List[Finding] = []
+    # arg 0 is the params tree in every cached program (batch args are
+    # row-sharded by design — only PARAM leaves must carry the model axis)
+    params_abstract = rec["abstract"][0] if rec["abstract"] else ()
+    for leaf in jax.tree_util.tree_leaves(params_abstract):
+        shape = tuple(getattr(leaf, "shape", ()) or ())
+        dtype = getattr(leaf, "dtype", None)
+        if dtype is None:
+            continue
+        nbytes = int(np.prod(shape, dtype=np.int64)
+                     * np.dtype(dtype).itemsize)
+        if nbytes < threshold:
+            continue
+        if "model" not in _spec_axes(getattr(leaf, "sharding", None)):
+            findings.append(Finding(
+                "replicated-large-leaf", "error", f"program:{where}",
+                f"param leaf {shape}/{dtype} ({nbytes} bytes) is fully "
+                f"replicated on a mesh with a 'model' axis — shard it "
+                f"(plan.param_pspecs) or it re-caps per-chip memory at "
+                f"the single-chip bound"))
+    return findings
+
+
 def audit_cache(cache, *, expect_donation: Optional[bool] = None,
                 seq_threshold: Optional[int] = None,
-                const_bytes_threshold: int = CONST_BYTES_THRESHOLD
+                const_bytes_threshold: int = CONST_BYTES_THRESHOLD,
+                replicated_leaf_threshold: int = REPLICATED_LEAF_BYTES
                 ) -> List[Finding]:
     """Audit every program a `CompiledProgramCache` has compiled this
     process, via the audit records the cache keeps per key (builder +
@@ -327,6 +403,8 @@ def audit_cache(cache, *, expect_donation: Optional[bool] = None,
                 f"{rec['key'][0]} program compiled without donating the "
                 f"shared KV page pool — the pool is the server's entire "
                 f"generation memory, double-buffered on every step"))
+        findings.extend(_replicated_large_leaves(
+            rec, where, replicated_leaf_threshold))
         closed = jax.make_jaxpr(rec["build"]())(*rec["abstract"])
         findings.extend(audit_jaxpr(
             closed, where=where, policy=policy,

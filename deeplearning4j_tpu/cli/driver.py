@@ -173,9 +173,12 @@ def cmd_train(args) -> int:
     ckpt_dir = getattr(args, "checkpoint_dir", None)
     ckpt_every = int(props.get("checkpoint_every", "10"))
     zero1 = bool(getattr(args, "zero1", False))
+    mesh_spec = getattr(args, "mesh", None)
+    if mesh_spec is not None:
+        args.runtime = "mesh"  # --mesh implies the mesh runtime
     if zero1 and args.runtime != "mesh":
         raise SystemExit("--zero1 shards updater state over the dp mesh "
-                         "axis; it requires --runtime mesh")
+                         "axis; it requires --runtime mesh (or --mesh)")
     if ckpt_dir and (deep_ae or conf.pretrain):
         raise SystemExit(
             "--checkpoint-dir does not support pretraining recipes "
@@ -193,30 +196,40 @@ def cmd_train(args) -> int:
         net = MultiLayerNetwork(conf).init()
         _attach_compile_cache(net, args)
         n_dev = device_count()
-        mesh = make_mesh({"dp": n_dev})
+        plan = None
+        if mesh_spec is not None:
+            from deeplearning4j_tpu.parallel.plan import (
+                ShardPlan, parse_mesh_spec, plan_mesh)
+
+            plan = ShardPlan(mesh=plan_mesh(parse_mesh_spec(mesh_spec)))
+            mesh = plan.mesh
+            dp_rows = plan.rows
+        else:
+            mesh = make_mesh({"dp": n_dev})
+            dp_rows = n_dev
         batch = int(props.get("batch", "128"))
         n = data.num_examples()
-        if n < n_dev:
+        if n < dp_rows:
             raise SystemExit(
-                f"mesh runtime needs >= {n_dev} examples (one per device), "
-                f"got {n}")
-        remainder = sum(b.num_examples() % n_dev
+                f"mesh runtime needs >= {dp_rows} examples (one per row "
+                f"shard), got {n}")
+        remainder = sum(b.num_examples() % dp_rows
                         for b in data.batch_by(batch))
         if remainder:
-            if zero1:
-                raise SystemExit(
-                    f"--zero1 needs every batch divisible by the {n_dev}-"
-                    f"device dp axis ({remainder} examples/epoch are not): "
-                    f"pick a batch size that divides the dataset, or drop "
-                    f"--zero1")
             # remainder batches run through the pad-and-mask step (see
-            # DataParallelTrainer._step_padded): every example still
-            # trains, at the cost of one extra compiled variant
+            # DataParallelTrainer._step_padded) in every mode — zero1 and
+            # plan steps included: every example still trains, at the
+            # cost of one extra compiled variant
             print(f"note: {remainder} examples/epoch take the padded-batch "
-                  f"path to stay divisible by the {n_dev}-device dp axis",
+                  f"path to stay divisible by the {dp_rows}-row dp axis",
                   file=sys.stderr)
-        trainer = DataParallelTrainer(
-            net, mesh, mode=props.get("mode", "sync"), zero1=zero1)
+        if plan is not None:
+            trainer = DataParallelTrainer(
+                net, mode=props.get("mode", "sync"), zero1=zero1,
+                plan=plan)
+        else:
+            trainer = DataParallelTrainer(
+                net, mesh, mode=props.get("mode", "sync"), zero1=zero1)
         if ckpt_dir:
             # crash-safe + elastic: full TrainState (params, updater
             # moments, step, RNG key, batch cursor) checkpoints through
@@ -241,6 +254,11 @@ def cmd_train(args) -> int:
         # cache (track_jit); report those instead of the bypassed
         # single-chip step cache
         step_stats = trainer.compile_cache.stats
+        if plan is not None and plan.has_model_axis:
+            # params stay tensor-sharded after fit: score (and the
+            # final save's host gather) through the same plan instead
+            # of a single-chip program that can't accept them
+            net.set_serve_mesh(mesh=plan.mesh)
     else:
         net = MultiLayerNetwork(conf).init()
         _attach_compile_cache(net, args)
@@ -429,6 +447,11 @@ def cmd_warmup(args) -> int:
     else:
         raise SystemExit("warmup needs --model <conf.json | checkpoint dir>")
     net.set_compile_cache(args.compile_cache)
+    mesh_devices = None
+    if getattr(args, "mesh", None) is not None:
+        # BEFORE warmup, so the warmed programs carry the mesh cache key
+        # (same ordering rule as the precision policy below)
+        mesh_devices = int(net.set_serve_mesh(spec=args.mesh).devices.size)
     precision = getattr(args, "precision", "f32")
     if precision != "f32":
         # BEFORE warmup, so the warmed programs carry the policy cache
@@ -448,6 +471,7 @@ def cmd_warmup(args) -> int:
                                                draft=_gen_draft_net(args))
         summary["infer_cache"] = net.infer_cache.stats.as_dict()
     summary["precision"] = net.serve_precision
+    summary["mesh_devices"] = mesh_devices
     summary["disk_cache"] = _disk_stats(net)
     print(json.dumps(summary))
     return 0
@@ -485,6 +509,10 @@ def _gen_draft_net(args):
         raise SystemExit("--gen-draft requires --gen-spec-k >= 2")
     draft = _load_model(path)
     _attach_compile_cache(draft, args)
+    if getattr(args, "mesh", None) is not None:
+        # the draft's programs join the same plan-keyed cache family
+        # (speculative verify is keyed by the target's plan)
+        draft.set_serve_mesh(spec=args.mesh)
     return draft
 
 
@@ -515,6 +543,10 @@ def cmd_generate(args) -> int:
 
     net = _load_model(args.model)
     _attach_compile_cache(net, args)
+    if getattr(args, "mesh", None) is not None:
+        # before warmup_generate, so the decode/prefill programs carry
+        # the plan's cache key
+        net.set_serve_mesh(spec=args.mesh)
     prompt = [int(t) for t in args.prompt.split(",") if t.strip()]
     if not prompt:
         raise SystemExit("generate needs --prompt <id,id,...>")
@@ -568,9 +600,9 @@ def _build_server(args):
     net = _load_model(args.model)
     _attach_compile_cache(net, args)
     mesh_devices = None
-    if getattr(args, "mesh", False):
+    if getattr(args, "mesh", None) is not None:
         # before warmup, so the warmed programs carry the mesh cache key
-        mesh_devices = int(net.set_serve_mesh().devices.size)
+        mesh_devices = int(net.set_serve_mesh(spec=args.mesh).devices.size)
     precision = getattr(args, "precision", "f32")
     precision_report = None
     if precision != "f32":
@@ -682,8 +714,8 @@ def _replica_cmd(args) -> List[str]:
         cmd += ["--no-batching"]
     if getattr(args, "default_deadline_ms", None) is not None:
         cmd += ["--default-deadline-ms", str(args.default_deadline_ms)]
-    if getattr(args, "mesh", False):
-        cmd += ["--mesh"]
+    if getattr(args, "mesh", None) is not None:
+        cmd += ["--mesh", args.mesh]
     if getattr(args, "precision", "f32") != "f32":
         cmd += ["--precision", args.precision]
     return cmd
@@ -955,10 +987,19 @@ def build_parser() -> argparse.ArgumentParser:
                         "lenet5|mlp|char_lstm[:k=v,...] (e.g. "
                         "char_lstm:layers=4,hidden=128)")
     t.add_argument("--runtime", choices=["local", "mesh"], default="local")
+    t.add_argument("--mesh", nargs="?", const="all", default=None,
+                   metavar="SPEC",
+                   help="device mesh spec like batch=2,model=4 (implies "
+                        "--runtime mesh); a model axis tensor-shards "
+                        "params/grads per the ShardPlan so one model can "
+                        "exceed one chip's HBM, and checkpoints write "
+                        "per-shard (save_sharded); bare --mesh or "
+                        "--mesh all is the 1-D batch=all-devices layout")
     t.add_argument("--zero1", action="store_true",
                    help="ZeRO-1: shard updater (optimizer) state over the "
-                        "dp mesh axis instead of replicating it (needs "
-                        "--runtime mesh and dp-divisible batches); "
+                        "dp mesh axis instead of replicating it; non-dp-"
+                        "divisible batches pad-and-mask like every other "
+                        "mode; composes with a --mesh model axis; "
                         "checkpoints gather to full shape, so resume "
                         "works on any device count")
     t.add_argument("--properties", default=None,
@@ -1007,6 +1048,13 @@ def build_parser() -> argparse.ArgumentParser:
                         "output,feed_forward,loss")
     w.add_argument("--train", action="store_true",
                    help="also compile the train step for each shape")
+    w.add_argument("--mesh", nargs="?", const="all", default=None,
+                   metavar="SPEC",
+                   help="warm under a serve mesh ('' spec / bare flag = "
+                        "1-D batch mesh; batch=2,model=4 adds tensor "
+                        "parallelism) so the warmed programs carry the "
+                        "mesh cache key a `serve --mesh` process with the "
+                        "same spec will look up")
     w.add_argument("--precision", choices=["f32", "bf16", "int8"],
                    default="f32",
                    help="serve-precision policy to warm under (set BEFORE "
@@ -1057,6 +1105,12 @@ def build_parser() -> argparse.ArgumentParser:
     g.add_argument("--spec-k", dest="gen_spec_k", type=int, default=0,
                    help="speculative chunk size (>= 2; draft proposes "
                         "spec_k - 1 tokens per verify step)")
+    g.add_argument("--mesh", nargs="?", const="all", default=None,
+                   metavar="SPEC",
+                   help="decode on a device mesh (bare flag = 1-D batch "
+                        "mesh; batch=1,model=4 shards params and KV "
+                        "state over the model axis — greedy output "
+                        "token-identical to single-chip decode)")
     g.set_defaults(fn=cmd_generate)
 
     s = sub.add_parser("serve",
@@ -1133,11 +1187,16 @@ def build_parser() -> argparse.ArgumentParser:
                    default=500.0,
                    help="autoscaler latency objective: fleet p99 above "
                         "this is a scale-up signal")
-    s.add_argument("--mesh", action="store_true",
-                   help="shard each coalesced batch's rows across every "
-                        "visible device (Mesh(('batch',)), params "
-                        "replicated); bitwise-identical outputs, one "
-                        "program per sharding in the compile cache")
+    s.add_argument("--mesh", nargs="?", const="all", default=None,
+                   metavar="SPEC",
+                   help="shard serving across a device mesh: bare --mesh "
+                        "(or --mesh all) is the 1-D Mesh(('batch',)) over "
+                        "every visible device — rows split, params "
+                        "replicated, bitwise-identical outputs; a spec "
+                        "like batch=2,model=4 adds tensor parallelism "
+                        "(params, activations, and decode KV state "
+                        "sharded over the model axis per the ShardPlan); "
+                        "one program per sharding in the compile cache")
     s.add_argument("--precision", choices=["f32", "bf16", "int8"],
                    default="f32",
                    help="serve-precision policy (optimize/quantize.py): "

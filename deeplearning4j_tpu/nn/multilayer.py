@@ -385,18 +385,27 @@ class MultiLayerNetwork:
         self.infer_cache.set_persist(store)
         return store
 
-    def set_serve_mesh(self, mesh=None):
-        """Shard serve-path batches across a `Mesh(('batch',))` — rows
-        split over the mesh, params replicated, collectives inserted by
-        jit (the GSPMD pattern).  `mesh=None` (no argument) builds
-        `parallel.mesh.serve_mesh()` over every visible device; pass an
-        explicit mesh to use a subset.  Sharding is a cache-KEY
-        dimension, so single-chip and mesh programs coexist in memory
-        and on disk; outputs stay bitwise-identical either way (rows are
-        independent).  Returns the mesh."""
+    def set_serve_mesh(self, mesh=None, spec=None):
+        """Shard the serve path across a mesh.  With no arguments this
+        is the 1-D pattern: `Mesh(('batch',))` over every visible
+        device, rows split, params replicated, collectives inserted by
+        jit (GSPMD).  `spec` takes a `--mesh`-style string instead
+        ("batch=2,model=4", parsed by `parallel.plan.parse_mesh_spec`;
+        "all" or "" = the 1-D default): a `model` axis tensor-shards
+        params, activations, and decode KV state per the ShardPlan, so
+        one model can exceed one chip's HBM.  Sharding is a cache-KEY
+        dimension — single-chip, 1-D, and 2-D programs coexist in
+        memory and on disk, and 1-D keys are byte-identical to their
+        pre-plan form.  Returns the mesh."""
         from deeplearning4j_tpu.parallel.mesh import serve_mesh
+        from deeplearning4j_tpu.parallel.plan import (parse_mesh_spec,
+                                                      plan_mesh)
 
-        if mesh is None:
+        if mesh is not None and spec is not None:
+            raise ValueError("pass mesh= or spec=, not both")
+        if spec is not None:
+            mesh = plan_mesh(parse_mesh_spec(spec))
+        elif mesh is None:
             mesh = serve_mesh()
         self.infer_cache.set_mesh(mesh)
         return mesh

@@ -131,11 +131,13 @@ class InferCache(CompiledProgramCache):
                 self._fingerprints[id(conf)] = fp
             return fp
 
-    # -- mesh ----------------------------------------------------------------
+    # -- mesh / plan ---------------------------------------------------------
     def set_mesh(self, mesh) -> None:
-        """Shard every subsequent serve call's rows across `mesh`
-        (`Mesh(('batch',))`, params replicated — `parallel.mesh.
-        serve_mesh()` builds it); None reverts to single-chip programs.
+        """Shard every subsequent serve call's rows across `mesh`:
+        `Mesh(('batch',))` keeps params replicated (`parallel.mesh.
+        serve_mesh()` builds it), a 2-D `Mesh(('batch','model'))`
+        additionally tensor-shards params — and decode state — per the
+        plan's per-leaf specs; None reverts to single-chip programs.
         Already-compiled programs stay cached under their own sharding
         tag, so flipping back and forth never evicts or recompiles."""
         from deeplearning4j_tpu.parallel.mesh import infer_shardings
@@ -151,6 +153,28 @@ class InferCache(CompiledProgramCache):
     @property
     def mesh(self):
         return self._mesh
+
+    @property
+    def plan(self):
+        """The cache's current `ShardPlan` — derived from (mesh,
+        policy) so there is exactly one source of truth.  Every cache
+        key element (`sharding_tag`, policy suffix, decode tag) and
+        every placement routes through it."""
+        from deeplearning4j_tpu.parallel.plan import ShardPlan
+
+        with self._lock:
+            return ShardPlan(mesh=self._mesh, policy=self._policy)
+
+    def set_plan(self, plan) -> None:
+        """Install a `ShardPlan` wholesale: mesh and precision policy in
+        one call.  int8 plans need the quantized tree installed first
+        via `set_policy` (the plan carries the policy NAME, not the
+        snapshot)."""
+        if plan.policy != self._policy:
+            self.set_policy(plan.policy,
+                            qparams=self._qparams
+                            if plan.policy == "int8" else None)
+        self.set_mesh(plan.mesh)
 
     # -- precision policy ---------------------------------------------------
     def set_policy(self, policy: str, qparams=None) -> None:
@@ -178,12 +202,11 @@ class InferCache(CompiledProgramCache):
         return self._policy
 
     def _policy_suffix(self) -> Tuple:
-        """Cache-key elements the policy contributes.  f32 contributes
-        NOTHING — its keys (and therefore its disk-store paths and its
-        outputs) are byte-identical to the pre-policy serve path."""
-        if self._policy == "f32":
-            return ()
-        return (("policy", self._policy),)
+        """Cache-key elements the policy contributes (the plan's
+        `policy_suffix`).  f32 contributes NOTHING — its keys (and
+        therefore its disk-store paths and its outputs) are
+        byte-identical to the pre-policy serve path."""
+        return self.plan.policy_suffix()
 
     def _serve_params(self, params):
         """The params tree the policy's programs take as argument: f32
@@ -225,17 +248,24 @@ class InferCache(CompiledProgramCache):
                                            r["sharding"], r["policy"]))
 
     def _mesh_rows(self) -> int:
-        """Row-divisibility the current sharding demands (1 = no mesh)."""
-        return 1 if self._mesh is None else int(self._mesh.devices.size)
+        """Row-divisibility the current plan demands (1 = no mesh; 2-D
+        meshes only need the BATCH axis to divide the rows)."""
+        return self.plan.rows
 
     def sharding_tag(self):
-        """The sharding dimension of the cache key: 'single' or a
-        (mesh, axis names, mesh shape) tuple.  Distinct tags can never
-        alias — single-chip and mesh programs coexist."""
-        if self._mesh is None:
-            return self.SINGLE
-        return ("mesh", tuple(self._mesh.axis_names),
-                tuple(int(d) for d in self._mesh.devices.shape))
+        """The sharding dimension of the cache key (the plan's
+        `sharding_tag`): 'single' or a (mesh, axis names, mesh shape)
+        tuple.  Distinct tags can never alias — single-chip and mesh
+        programs coexist."""
+        return self.plan.sharding_tag()
+
+    def _decode_tag(self):
+        """Sharding key element for decode/prefill/verify entries (the
+        plan's `decode_tag`): generation stays single-chip — and its
+        keys stay byte-identical to pre-plan disk artifacts — unless
+        the plan carries a `model` axis, which genuinely re-keys the
+        programs (sharded KV tables, jit-inserted collectives)."""
+        return self.plan.decode_tag()
 
     def _serve_bucket(self, n: int) -> int:
         """Bucket for `n` rows.  Under a mesh the bucket must divide
@@ -256,32 +286,47 @@ class InferCache(CompiledProgramCache):
                 self._buckets.sort()
             return target
 
-    def _shardings(self, n_batch_args: int) -> Optional[Tuple]:
-        """(params sharding, batch shardings...) under the mesh; None
-        single-chip."""
+    def _shardings(self, sp, n_batch_args: int) -> Optional[Tuple]:
+        """(params sharding(s), batch shardings...) under the mesh; None
+        single-chip.  1-D meshes replicate params (one Sharding covers
+        the whole subtree — the pre-plan placement, byte-identical
+        keys); a `model` axis switches the params entry to the plan's
+        per-leaf sharding tree."""
         if self._mesh is None:
             return None
+        plan = self.plan
+        if plan.has_model_axis:
+            return ((plan.param_shardings(sp),)
+                    + (plan.batch_sharding(),) * int(n_batch_args))
         from deeplearning4j_tpu.parallel.mesh import serve_placements
 
         return serve_placements(self._mesh, n_batch_args)
 
-    def _place(self, params, *batch_args) -> Tuple:
-        """Device placement for execution under the mesh: params
-        replicated once per tree (memoized — serving reuses one tree for
-        every request), batch args row-sharded."""
-        if self._mesh is None:
-            return (params,) + batch_args
+    def _place_params(self, params):
+        """Mesh placement of the params tree, memoized per tree
+        identity (serving reuses one tree for every request):
+        replicated under a 1-D plan, per-leaf tensor-sharded under a
+        `model` axis."""
         with self._lock:
             held, placed = self._placed_params
             if held is params:
-                params_placed = placed
-            else:
-                params_placed = None
-        if params_placed is None:
-            params_placed = jax.device_put(params, self._replicated)
-            with self._lock:
-                self._placed_params = (params, params_placed)
-        return (params_placed,) + tuple(
+                return placed
+        plan = self.plan
+        if plan.has_model_axis:
+            placed = jax.tree_util.tree_map(
+                jax.device_put, params, plan.param_shardings(params))
+        else:
+            placed = jax.device_put(params, self._replicated)
+        with self._lock:
+            self._placed_params = (params, placed)
+        return placed
+
+    def _place(self, params, *batch_args) -> Tuple:
+        """Device placement for execution under the mesh: params per
+        `_place_params`, batch args row-sharded."""
+        if self._mesh is None:
+            return (params,) + batch_args
+        return (self._place_params(params),) + tuple(
             jax.device_put(a, self._batch_sharding) for a in batch_args)
 
     # -- entry points -------------------------------------------------------
@@ -297,7 +342,7 @@ class InferCache(CompiledProgramCache):
         key = ("output", self._fingerprint(conf), arg_signature(xp),
                self.sharding_tag()) + self._policy_suffix()
         fn = self._get(key, lambda: _output_program(conf, policy), (sp, xp),
-                       shardings=self._shardings(1))
+                       shardings=self._shardings(sp, 1))
         if compile_only:
             return None
         with self._lock:
@@ -314,7 +359,7 @@ class InferCache(CompiledProgramCache):
         key = ("feed_forward", self._fingerprint(conf), arg_signature(xp),
                self.sharding_tag()) + self._policy_suffix()
         fn = self._get(key, lambda: _feed_forward_program(conf, policy),
-                       (sp, xp), shardings=self._shardings(1))
+                       (sp, xp), shardings=self._shardings(sp, 1))
         if compile_only:
             return None
         with self._lock:
@@ -335,12 +380,80 @@ class InferCache(CompiledProgramCache):
 
         return (1,) if default_backend() != "cpu" else ()
 
+    def _decode_shardings(self, sp, state, n_rest: int) -> Optional[Tuple]:
+        """Per-arg shardings for a decode-family program under a
+        tensor-parallel plan: params and KV state per the plan's
+        per-leaf specs, the small host args (tok/pos/keys/temps/
+        page_table) replicated.  None without a `model` axis —
+        generation stays a single-chip program exactly as before."""
+        plan = self.plan
+        if not plan.has_model_axis:
+            return None
+        rep = plan.replicated()
+        return ((plan.param_shardings(sp), plan.state_shardings(state))
+                + (rep,) * int(n_rest))
+
+    def _decode_place(self, sp, state, *rest) -> Tuple:
+        """Execution placement for a TP decode call: params memoized
+        per-leaf, state leaves pinned to the plan's specs (a no-op for
+        the steady-state loop — the program's output constraint keeps
+        the donated state on-spec), host args replicated."""
+        plan = self.plan
+        if not plan.has_model_axis:
+            return (sp, state) + rest
+        rep = plan.replicated()
+        state = jax.tree_util.tree_map(jax.device_put, state,
+                                       plan.state_shardings(state))
+        return (self._place_params(sp), state) + tuple(
+            jax.device_put(a, rep) for a in rest)
+
+    def _tp_build(self, build):
+        """Wrap a decode-family program builder for a tensor-parallel
+        plan: the returned program pins its (donated, state-last)
+        output state to the plan's per-leaf specs with
+        `with_sharding_constraint` INSIDE the traced function — so the
+        compiled executable's output layout provably matches its input
+        layout and the next step's call is a pure hit, never a
+        reshard."""
+        plan = self.plan
+        if not plan.has_model_axis:
+            return build
+        mesh = plan.mesh
+
+        def wrapped():
+            base = build()
+
+            def program(*args):
+                out = base(*args)
+                *rest, st = out
+                st = jax.tree_util.tree_map(
+                    lambda a, s: jax.lax.with_sharding_constraint(
+                        a, jax.sharding.NamedSharding(mesh, s)),
+                    st, plan.state_pspecs(st))
+                return tuple(rest) + (st,)
+
+            return program
+
+        return wrapped
+
+    def _place_decode_state(self, state):
+        """Plan placement for a fresh decode state (no-op without a
+        `model` axis)."""
+        plan = self.plan
+        if not plan.has_model_axis:
+            return state
+        return jax.tree_util.tree_map(jax.device_put, state,
+                                      plan.state_shardings(state))
+
     def init_decode_state(self, conf, batch: int, max_seq: int):
-        """Fresh decode state shaped for the active policy's programs."""
+        """Fresh decode state shaped for the active policy's programs,
+        placed per the active plan (a `model` axis shards the K/V
+        feature dims so the cache itself can exceed one chip's HBM)."""
         from deeplearning4j_tpu.nn import decode as decode_mod
 
-        return decode_mod.init_state(_policy_conf(conf, self._policy),
-                                     batch, max_seq)
+        return self._place_decode_state(
+            decode_mod.init_state(_policy_conf(conf, self._policy),
+                                  batch, max_seq))
 
     def decode(self, conf, params, state, tok, pos, keys, temps,
                compile_only: bool = False):
@@ -348,31 +461,36 @@ class InferCache(CompiledProgramCache):
         tok/pos [B] int32, keys [B, 2] uint32 per-row PRNG keys, temps
         [B] f32 (<= 0 rows decode greedily).  Returns (next_tok [B]
         int32, advanced keys, new state); the state argument is donated
-        off-CPU.  Generation is single-chip — the key carries the SINGLE
-        tag regardless of any serve mesh."""
+        off-CPU.  Under a 1-D (or no) mesh generation is single-chip and
+        the key carries the SINGLE tag exactly as before; a plan with a
+        `model` axis re-keys the program by its sharding tag and shards
+        params + KV state per the plan."""
         policy, sp = self._policy, self._serve_params(params)
         key = ("decode", self._fingerprint(conf),
                arg_signature(tok, pos, keys, temps,
                              *jax.tree_util.tree_leaves(state)),
-               self.SINGLE) + self._policy_suffix()
-        fn = self._get(key, lambda: _decode_program(conf, policy),
+               self._decode_tag()) + self._policy_suffix()
+        fn = self._get(key,
+                       self._tp_build(lambda: _decode_program(conf, policy)),
                        (sp, state, tok, pos, keys, temps),
-                       donate=self._decode_donate())
+                       donate=self._decode_donate(),
+                       shardings=self._decode_shardings(sp, state, 4))
         if compile_only:
             return None
         with self._lock:
             self.stats.steps += 1
-        return fn(sp, state, tok, pos, keys, temps)
+        return fn(*self._decode_place(sp, state, tok, pos, keys, temps))
 
     # -- paged decode + speculative verification (ISSUE 16) ------------------
     def init_paged_decode_state(self, conf, batch: int, n_pages: int,
                                 page_size: int):
         """Fresh paged decode state (shared K/V page pool) shaped for
-        the active policy's programs."""
+        the active policy's programs, placed per the active plan (the
+        page pool's feature dim shards over a `model` axis by head)."""
         from deeplearning4j_tpu.nn import decode as decode_mod
 
-        return decode_mod.init_paged_state(
-            _policy_conf(conf, self._policy), batch, n_pages, page_size)
+        return self._place_decode_state(decode_mod.init_paged_state(
+            _policy_conf(conf, self._policy), batch, n_pages, page_size))
 
     def decode_paged(self, conf, params, state, tok, pos, keys, temps,
                      page_table, compile_only: bool = False):
@@ -385,15 +503,18 @@ class InferCache(CompiledProgramCache):
         key = ("decode-paged", self._fingerprint(conf),
                arg_signature(tok, pos, keys, temps, page_table,
                              *jax.tree_util.tree_leaves(state)),
-               self.SINGLE) + self._policy_suffix()
-        fn = self._get(key, lambda: _decode_paged_program(conf, policy),
-                       (sp, state, tok, pos, keys, temps, page_table),
-                       donate=self._decode_donate())
+               self._decode_tag()) + self._policy_suffix()
+        fn = self._get(
+            key, self._tp_build(lambda: _decode_paged_program(conf, policy)),
+            (sp, state, tok, pos, keys, temps, page_table),
+            donate=self._decode_donate(),
+            shardings=self._decode_shardings(sp, state, 5))
         if compile_only:
             return None
         with self._lock:
             self.stats.steps += 1
-        return fn(sp, state, tok, pos, keys, temps, page_table)
+        return fn(*self._decode_place(sp, state, tok, pos, keys, temps,
+                                      page_table))
 
     def verify(self, conf, params, state, toks, pos, keys, temps,
                compile_only: bool = False):
@@ -412,15 +533,17 @@ class InferCache(CompiledProgramCache):
         key = ("verify", self._fingerprint(conf),
                arg_signature(toks, pos, keys, temps,
                              *jax.tree_util.tree_leaves(state)),
-               self.SINGLE) + self._policy_suffix()
-        fn = self._get(key, lambda: _verify_program(conf, policy),
+               self._decode_tag()) + self._policy_suffix()
+        fn = self._get(key,
+                       self._tp_build(lambda: _verify_program(conf, policy)),
                        (sp, state, toks, pos, keys, temps),
-                       donate=self._decode_donate())
+                       donate=self._decode_donate(),
+                       shardings=self._decode_shardings(sp, state, 4))
         if compile_only:
             return None
         with self._lock:
             self.stats.steps += 1
-        return fn(sp, state, toks, pos, keys, temps)
+        return fn(*self._decode_place(sp, state, toks, pos, keys, temps))
 
     def verify_paged(self, conf, params, state, toks, pos, keys, temps,
                      page_table, compile_only: bool = False):
@@ -429,15 +552,18 @@ class InferCache(CompiledProgramCache):
         key = ("verify-paged", self._fingerprint(conf),
                arg_signature(toks, pos, keys, temps, page_table,
                              *jax.tree_util.tree_leaves(state)),
-               self.SINGLE) + self._policy_suffix()
-        fn = self._get(key, lambda: _verify_paged_program(conf, policy),
-                       (sp, state, toks, pos, keys, temps, page_table),
-                       donate=self._decode_donate())
+               self._decode_tag()) + self._policy_suffix()
+        fn = self._get(
+            key, self._tp_build(lambda: _verify_paged_program(conf, policy)),
+            (sp, state, toks, pos, keys, temps, page_table),
+            donate=self._decode_donate(),
+            shardings=self._decode_shardings(sp, state, 5))
         if compile_only:
             return None
         with self._lock:
             self.stats.steps += 1
-        return fn(sp, state, toks, pos, keys, temps, page_table)
+        return fn(*self._decode_place(sp, state, toks, pos, keys, temps,
+                                      page_table))
 
     def prefill(self, conf, params, state, prompt, length, keys, temps,
                 compile_only: bool = False):
@@ -451,15 +577,17 @@ class InferCache(CompiledProgramCache):
         key = ("prefill", self._fingerprint(conf),
                arg_signature(prompt, length, keys, temps,
                              *jax.tree_util.tree_leaves(state)),
-               self.SINGLE) + self._policy_suffix()
-        fn = self._get(key, lambda: _prefill_program(conf, policy),
+               self._decode_tag()) + self._policy_suffix()
+        fn = self._get(key,
+                       self._tp_build(lambda: _prefill_program(conf, policy)),
                        (sp, state, prompt, length, keys, temps),
-                       donate=self._decode_donate())
+                       donate=self._decode_donate(),
+                       shardings=self._decode_shardings(sp, state, 4))
         if compile_only:
             return None
         with self._lock:
             self.stats.steps += 1
-        return fn(sp, state, prompt, length, keys, temps)
+        return fn(*self._decode_place(sp, state, prompt, length, keys, temps))
 
     def prefill_logp(self, conf, params, state, prompt, length,
                      compile_only: bool = False):
@@ -476,15 +604,17 @@ class InferCache(CompiledProgramCache):
         key = ("prefill-logp", self._fingerprint(conf),
                arg_signature(prompt, length,
                              *jax.tree_util.tree_leaves(state)),
-               self.SINGLE) + self._policy_suffix()
-        fn = self._get(key, lambda: _prefill_logp_program(conf, policy),
-                       (sp, state, prompt, length),
-                       donate=self._decode_donate())
+               self._decode_tag()) + self._policy_suffix()
+        fn = self._get(
+            key, self._tp_build(lambda: _prefill_logp_program(conf, policy)),
+            (sp, state, prompt, length),
+            donate=self._decode_donate(),
+            shardings=self._decode_shardings(sp, state, 2))
         if compile_only:
             return None
         with self._lock:
             self.stats.steps += 1
-        return fn(sp, state, prompt, length)
+        return fn(*self._decode_place(sp, state, prompt, length))
 
     def loss(self, conf, params, x, y, compile_only: bool = False):
         """`network_loss(training=False)` through the cache: the
@@ -498,7 +628,7 @@ class InferCache(CompiledProgramCache):
         key = ("loss", self._fingerprint(conf), arg_signature(xp, yp, w),
                self.sharding_tag()) + self._policy_suffix()
         fn = self._get(key, lambda: _loss_program(conf, policy),
-                       (sp, xp, yp, w), shardings=self._shardings(3))
+                       (sp, xp, yp, w), shardings=self._shardings(sp, 3))
         if compile_only:
             return None
         with self._lock:

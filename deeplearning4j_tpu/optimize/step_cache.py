@@ -212,11 +212,66 @@ class CompiledProgramCache:
     def audit_records(self) -> List[dict]:
         """Snapshot of the per-program audit records (one per compiled
         or disk-restored key): {key, kind, build, abstract,
-        donate_argnums, mesh}.  `analysis.program_audit.audit_cache`
-        re-traces each builder against its abstract args to inspect the
-        jaxpr without executing anything."""
+        donate_argnums, mesh, shardings}.
+        `analysis.program_audit.audit_cache` re-traces each builder
+        against its abstract args to inspect the jaxpr without
+        executing anything; the `shardings` entry (per-arg Sharding or
+        per-leaf pytree, None single-chip) feeds the
+        replicated-large-leaf rule."""
         with self._lock:
             return list(self._audit_records.values())
+
+    def program_memory(self) -> List[dict]:
+        """Per-program per-device argument-memory estimate, one row per
+        audit record: `per_device_argument_bytes` sums each abstract
+        leaf's shard size under its recorded sharding (the bytes ONE
+        chip holds), `replicated_argument_bytes` the unsharded total —
+        the pair that proves a tensor-parallel plan fits where a
+        replicated one cannot.  When the backend exposes it, the
+        compiled executable's `memory_analysis()` is attached verbatim
+        under `memory_analysis` (argument/output/temp/generated-code
+        sizes); backends without it (CPU) leave it None, which is why
+        the estimate is computed from the avals and always present."""
+        import numpy as np
+
+        with self._lock:
+            recs = list(self._audit_records.values())
+            programs = dict(self._programs)
+        rows = []
+        for rec in recs:
+            per_dev = total = 0
+            for leaf in jax.tree_util.tree_leaves(rec["abstract"]):
+                shape = tuple(getattr(leaf, "shape", ()) or ())
+                nbytes = int(np.prod(shape, dtype=np.int64)
+                             * np.dtype(leaf.dtype).itemsize)
+                total += nbytes
+                s = getattr(leaf, "sharding", None)
+                if s is not None:
+                    shard = tuple(s.shard_shape(shape))
+                    per_dev += int(np.prod(shard, dtype=np.int64)
+                                   * np.dtype(leaf.dtype).itemsize)
+                else:
+                    per_dev += nbytes
+            analysis = None
+            fn = programs.get(rec["key"])
+            try:
+                mem = fn.memory_analysis() if fn is not None else None
+                if mem is not None:
+                    analysis = {
+                        k: int(getattr(mem, k))
+                        for k in ("argument_size_in_bytes",
+                                  "output_size_in_bytes",
+                                  "temp_size_in_bytes",
+                                  "generated_code_size_in_bytes")
+                        if hasattr(mem, k)}
+            except Exception:  # noqa: BLE001 — backend without analysis
+                analysis = None
+            rows.append({"key": rec["key"],
+                         "entry": rec["key"][0] if rec["key"] else None,
+                         "per_device_argument_bytes": int(per_dev),
+                         "replicated_argument_bytes": int(total),
+                         "memory_analysis": analysis})
+        return rows
 
     def _get(self, key: Tuple, build: Callable[[], Callable], args: Tuple,
              shardings: Optional[Tuple] = None,
@@ -227,11 +282,15 @@ class CompiledProgramCache:
         lock: two threads racing a miss would otherwise compile (and
         persist) the same program twice.
 
-        shardings: optional per-arg `jax.sharding.Sharding`s (None =
-        default single-device placement).  Each entry is applied to every
-        leaf of the matching arg subtree, so a mesh-sharded program
-        (replicated params, row-sharded batch) compiles with jit-inserted
-        collectives — the caller must fold the sharding into `key`.
+        shardings: optional per-arg shardings (None = default
+        single-device placement).  Each entry is either ONE
+        `jax.sharding.Sharding` applied to every leaf of the matching
+        arg subtree (replicated params, row-sharded batch — the 1-D
+        serve pattern), or a PYTREE of shardings matching the arg
+        leaf-for-leaf (tensor-parallel plans place each param / KV
+        leaf differently).  Either way the program compiles with
+        jit-inserted collectives — the caller must fold the sharding
+        into `key`.
 
         donate: optional per-program donate_argnums override (None =
         the cache-wide `_donate_argnums()` policy).  Lets an entry with
@@ -253,17 +312,21 @@ class CompiledProgramCache:
                 lambda a: jax.ShapeDtypeStruct(jnp.shape(a),
                                                jnp.asarray(a).dtype), args)
         else:
+            def _abs(a, _s):
+                return jax.ShapeDtypeStruct(jnp.shape(a),
+                                            jnp.asarray(a).dtype,
+                                            sharding=_s)
+
             abstract = tuple(
-                jax.tree_util.tree_map(
-                    lambda a, _s=s: jax.ShapeDtypeStruct(
-                        jnp.shape(a), jnp.asarray(a).dtype, sharding=_s),
-                    arg)
+                jax.tree_util.tree_map(lambda a, _s=s: _abs(a, _s), arg)
+                if isinstance(s, jax.sharding.Sharding)
+                else jax.tree_util.tree_map(_abs, arg, s)
                 for arg, s in zip(args, shardings))
         donate = self._donate_argnums() if donate is None else tuple(donate)
         self._audit_records[key] = {
             "key": key, "kind": self.kind, "build": build,
             "abstract": abstract, "donate_argnums": donate,
-            "mesh": shardings is not None}
+            "mesh": shardings is not None, "shardings": shardings}
         if self._persist is not None:
             fn = self._load_from_disk(key, abstract, donate)
             self.stats.io_errors = self._persist.io_errors

@@ -57,14 +57,37 @@ class CheckpointFormatError(RuntimeError):
     one-line actionable diagnosis."""
 
 
-def _flatten_with_paths(tree) -> Dict[str, np.ndarray]:
+def _flatten_leaf_objects(tree) -> Dict[str, Any]:
+    """Leaves keyed by tree path ("params/3/W" style) WITHOUT copying
+    them to host — the shared path-key scheme of both layouts."""
     flat = {}
     leaves = jax.tree_util.tree_flatten_with_path(tree)[0]
     for path, leaf in leaves:
         key = "/".join(str(getattr(p, "key", getattr(p, "idx", p)))
                        for p in path)
-        flat[key] = np.asarray(jax.device_get(leaf))
+        flat[key] = leaf
     return flat
+
+
+def _flatten_with_paths(tree) -> Dict[str, np.ndarray]:
+    return {k: np.asarray(jax.device_get(v))
+            for k, v in _flatten_leaf_objects(tree).items()}
+
+
+def _atomic_swap(tmp: str, directory: str) -> None:
+    """Swing a fully-written tmp dir into place.  The previous
+    checkpoint moves to the deterministic '<dir>.bak' (which load()
+    falls back to if a crash lands between the two renames), then the
+    new one swings in and the backup is dropped."""
+    if os.path.isdir(directory):
+        bak = directory + ".bak"
+        if os.path.isdir(bak):
+            shutil.rmtree(bak)
+        os.replace(directory, bak)
+        os.replace(tmp, directory)
+        shutil.rmtree(bak, ignore_errors=True)
+    else:
+        os.replace(tmp, directory)
 
 
 def save(directory: str, params, updater=None, *, conf=None, step: int = 0,
@@ -99,19 +122,7 @@ def save(directory: str, params, updater=None, *, conf=None, step: int = 0,
         if conf is not None:
             with open(os.path.join(tmp, "conf.json"), "w") as f:
                 f.write(conf.to_json())
-        if os.path.isdir(directory):
-            # crash-safe swap: the previous checkpoint moves to the
-            # deterministic '<dir>.bak' (which load() falls back to if a
-            # crash lands between the two renames), then the new one swings
-            # in and the backup is dropped
-            bak = directory + ".bak"
-            if os.path.isdir(bak):
-                shutil.rmtree(bak)
-            os.replace(directory, bak)
-            os.replace(tmp, directory)
-            shutil.rmtree(bak, ignore_errors=True)
-        else:
-            os.replace(tmp, directory)
+        _atomic_swap(tmp, directory)
     except BaseException:
         shutil.rmtree(tmp, ignore_errors=True)
         raise
@@ -186,6 +197,276 @@ def join_async(timeout: Optional[float] = None) -> None:
     _raise_pending_async_error()
 
 
+# -- sharded layout (ISSUE 17, PR 10's remainder) ---------------------------
+#
+# The gathered layout above materializes every leaf at its GLOBAL shape
+# on host — exactly what a tensor-parallel plan exists to avoid.  The
+# sharded layout writes one piece per UNIQUE shard instead:
+#
+#   meta.json    as above, plus "layout": "sharded"
+#   index.json   {"leaves": {path: {"shape", "dtype",
+#                 "pieces": [{"key", "index": [[s,e], ...]}]}}}
+#   shards.npz   pieces keyed "path::i"
+#
+# Replicated shards dedup by their index bounds, so a fully-replicated
+# leaf saves exactly once and a model-sharded leaf saves 1/n-sized
+# pieces.  Loading with target shardings assembles each device's shard
+# from the overlapping pieces only (`jax.make_array_from_callback`), so
+# an N-device checkpoint restores onto an M-device mesh without either
+# side ever holding a global copy.
+
+
+def _leaf_pieces(leaf) -> Tuple[Tuple[int, ...], np.dtype, List[Tuple]]:
+    """(global_shape, dtype, [(bounds, host_piece), ...]) for one leaf —
+    one `np.array` copy per unique shard, never the global array."""
+    shape = tuple(int(d) for d in getattr(leaf, "shape", ()) or ())
+    shards = getattr(leaf, "addressable_shards", None)
+    if not shards:
+        arr = np.array(leaf)
+        return arr.shape, arr.dtype, [
+            (tuple((0, int(d)) for d in arr.shape), arr)]
+    dtype = np.dtype(leaf.dtype)
+    pieces, seen = [], set()
+    for sh in shards:
+        bounds = tuple(
+            (int(sl.indices(d)[0]), int(sl.indices(d)[1]))
+            for sl, d in zip(sh.index, shape))
+        if bounds in seen:
+            continue
+        seen.add(bounds)
+        pieces.append((bounds, np.array(sh.data)))
+    return shape, dtype, pieces
+
+
+def _collect_sharded(state) -> Tuple[Dict[str, np.ndarray], Dict[str, Any]]:
+    """Synchronous host snapshot of `state` as (pieces, index) — the
+    donate-safe copy `save_sharded_async` takes before backgrounding
+    the write (same contract as `_host_snapshot`, shard-sized)."""
+    pieces: Dict[str, np.ndarray] = {}
+    index: Dict[str, Any] = {}
+    for key, leaf in _flatten_leaf_objects(state).items():
+        shape, dtype, ps = _leaf_pieces(leaf)
+        entry = []
+        for i, (bounds, arr) in enumerate(ps):
+            pk = f"{key}::{i}"
+            pieces[pk] = arr
+            entry.append({"key": pk, "index": [list(b) for b in bounds]})
+        index[key] = {"shape": list(shape), "dtype": str(dtype),
+                      "pieces": entry}
+    return pieces, {"leaves": index}
+
+
+def _write_sharded(directory: str, pieces, index, conf, meta) -> str:
+    directory = os.fspath(directory)
+    parent = os.path.dirname(os.path.abspath(directory)) or "."
+    os.makedirs(parent, exist_ok=True)
+    tmp = tempfile.mkdtemp(prefix=".ckpt-tmp-", dir=parent)
+    try:
+        np.savez(os.path.join(tmp, "shards.npz"), **pieces)
+        with open(os.path.join(tmp, "index.json"), "w") as f:
+            json.dump(index, f)
+        with open(os.path.join(tmp, "meta.json"), "w") as f:
+            json.dump(meta, f, indent=1)
+        if conf is not None:
+            with open(os.path.join(tmp, "conf.json"), "w") as f:
+                f.write(conf.to_json())
+        _atomic_swap(tmp, directory)
+    except BaseException:
+        shutil.rmtree(tmp, ignore_errors=True)
+        raise
+    return directory
+
+
+def _sharded_meta(step, data_cursor, metadata, mesh) -> Dict[str, Any]:
+    return {"step": int(step), "data_cursor": data_cursor or {},
+            "metadata": metadata or {},
+            "format_version": FORMAT_VERSION,
+            "layout": "sharded",
+            "mesh": mesh or None}
+
+
+def save_sharded(directory: str, params, updater=None, *, conf=None,
+                 step: int = 0,
+                 data_cursor: Optional[Dict[str, Any]] = None,
+                 metadata: Optional[Dict[str, Any]] = None,
+                 mesh: Optional[Dict[str, Any]] = None) -> str:
+    """`save`, but per-shard: every leaf is written as its unique device
+    shards and no global array is ever materialized on host.  Load with
+    `load_sharded` (target shardings, shard-sized assembly) or plain
+    `load` (host-assembled, elastic-resume path)."""
+    if jax.process_index() != 0:
+        return directory
+    faults.fire("checkpoint.save", path=directory)
+    state = {"params": params}
+    if updater is not None:
+        state["updater"] = updater
+    pieces, index = _collect_sharded(state)
+    return _write_sharded(directory, pieces, index, conf,
+                          _sharded_meta(step, data_cursor, metadata, mesh))
+
+
+def save_sharded_async(directory: str, params, updater=None, *, conf=None,
+                       step: int = 0,
+                       data_cursor: Optional[Dict[str, Any]] = None,
+                       metadata: Optional[Dict[str, Any]] = None,
+                       mesh: Optional[Dict[str, Any]] = None
+                       ) -> threading.Thread:
+    """Off-thread `save_sharded`: the shard-sized host copies are taken
+    NOW (training may donate the live buffers), the npz/json writes run
+    in the background.  Same failure surfacing as `save_async`."""
+    _raise_pending_async_error()
+    if jax.process_index() != 0:
+        t = threading.Thread(target=lambda: None)
+        t.start()
+        return t
+    faults.fire("checkpoint.save", path=directory)
+    state = {"params": params}
+    if updater is not None:
+        state["updater"] = updater
+    pieces, index = _collect_sharded(state)
+    meta = _sharded_meta(step, data_cursor, metadata, mesh)
+
+    def run():
+        try:
+            _write_sharded(directory, pieces, index, conf, meta)
+        except BaseException as e:  # noqa: BLE001 — re-raised at next call
+            log.error("async sharded checkpoint save to %s failed: %r",
+                      directory, e)
+            with _async_lock:
+                _async_errors.append(e)
+
+    t = threading.Thread(target=run, daemon=True, name="dl4j-ckpt-save")
+    with _async_lock:
+        _async_threads[:] = [x for x in _async_threads if x.is_alive()]
+        _async_threads.append(t)
+    t.start()
+    return t
+
+
+def _read_sharded_index(directory: str) -> Tuple[Dict[str, Any],
+                                                 Dict[str, Any]]:
+    with open(os.path.join(directory, "index.json")) as f:
+        index = json.load(f)
+    with open(os.path.join(directory, "meta.json")) as f:
+        meta = json.load(f)
+    version = int(meta.get("format_version", 0))
+    if version > FORMAT_VERSION:
+        raise CheckpointFormatError(
+            f"checkpoint {directory} has format_version={version} but this "
+            f"build reads <= {FORMAT_VERSION} — upgrade deeplearning4j_tpu "
+            f"(or re-save the checkpoint with the older build)")
+    return index["leaves"], meta
+
+
+def _assemble_region(z, info: Dict[str, Any], region: Tuple[slice, ...],
+                     stats: Optional[Dict[str, Any]]) -> np.ndarray:
+    """Assemble ONE requested region of a leaf from the overlapping
+    saved pieces — the host working set is the region, never the leaf."""
+    shape = tuple(int(d) for d in info["shape"])
+    dtype = np.dtype(info["dtype"])
+    bounds = tuple(sl.indices(d)[:2] for sl, d in zip(region, shape))
+    out = np.zeros(tuple(e - s for s, e in bounds), dtype)
+    for piece in info["pieces"]:
+        pb = [tuple(b) for b in piece["index"]]
+        lo = [max(s, ps) for (s, _), (ps, _) in zip(bounds, pb)]
+        hi = [min(e, pe) for (_, e), (_, pe) in zip(bounds, pb)]
+        if any(a >= b for a, b in zip(lo, hi)):
+            continue
+        data = z[piece["key"]]
+        src = tuple(slice(a - ps, b - ps)
+                    for a, b, (ps, _) in zip(lo, hi, pb))
+        dst = tuple(slice(a - s, b - s)
+                    for a, b, (s, _) in zip(lo, hi, bounds))
+        out[dst] = data[src]
+        if stats is not None:
+            stats["max_piece_bytes"] = max(
+                stats.get("max_piece_bytes", 0), int(data.nbytes))
+            stats["pieces_read"] = stats.get("pieces_read", 0) + 1
+    if stats is not None:
+        stats["max_region_bytes"] = max(
+            stats.get("max_region_bytes", 0), int(out.nbytes))
+    return out
+
+
+def _load_sharded_impl(directory: str, like_params, like_updater,
+                       params_shardings, updater_shardings, stats
+                       ) -> Tuple[Any, Any, Dict[str, Any]]:
+    index, meta = _read_sharded_index(directory)
+
+    def restore(prefix, like, shardings):
+        paths = jax.tree_util.tree_flatten_with_path(like)
+        keys = ["/".join(str(getattr(p, "key", getattr(p, "idx", p)))
+                         for p in path) for path, _ in paths[0]]
+        missing = [k for k in keys if f"{prefix}/{k}" not in index]
+        if missing:
+            raise CheckpointFormatError(
+                f"checkpoint {directory} is missing {len(missing)} "
+                f"'{prefix}' leaves (first: {prefix}/{missing[0]}) — it "
+                f"was written for a different model config; point it at a "
+                f"checkpoint of THIS model or start fresh")
+        for k, (_, leaf) in zip(keys, paths[0]):
+            want = tuple(getattr(leaf, "shape", ()) or ())
+            got = tuple(index[f"{prefix}/{k}"]["shape"])
+            if want and got != want:
+                raise CheckpointFormatError(
+                    f"checkpoint {directory} leaf {prefix}/{k} has shape "
+                    f"{got}, model expects {want} — layer sizes differ; "
+                    f"this checkpoint belongs to a different config")
+        shard_leaves = (None if shardings is None else
+                        jax.tree_util.tree_flatten(
+                            shardings,
+                            is_leaf=lambda x: isinstance(
+                                x, jax.sharding.Sharding))[0])
+        with np.load(os.path.join(directory, "shards.npz")) as z:
+            leaves = []
+            for i, k in enumerate(keys):
+                info = index[f"{prefix}/{k}"]
+                shape = tuple(int(d) for d in info["shape"])
+                s = None if shard_leaves is None else shard_leaves[i]
+                if s is None:
+                    full = (slice(None),) * len(shape)
+                    leaves.append(jax.numpy.asarray(
+                        _assemble_region(z, info, full, stats)))
+                else:
+                    leaves.append(jax.make_array_from_callback(
+                        shape, s,
+                        lambda region, info=info: _assemble_region(
+                            z, info, region, stats)))
+            return jax.tree_util.tree_unflatten(paths[1], leaves)
+
+    if like_params is None:
+        raise CheckpointFormatError(
+            f"checkpoint {directory} has the sharded layout, which "
+            f"restores into an example pytree — pass like_params=")
+    params = restore("params", like_params, params_shardings)
+    updater = None
+    if like_updater is not None:
+        updater = restore("updater", like_updater, updater_shardings)
+    return params, updater, meta
+
+
+def load_sharded(directory: str, like_params=None, like_updater=None, *,
+                 params_shardings=None, updater_shardings=None,
+                 stats: Optional[Dict[str, Any]] = None
+                 ) -> Tuple[Any, Any, Dict[str, Any]]:
+    """Read a `save_sharded` checkpoint.  With `params_shardings` /
+    `updater_shardings` (pytrees of `jax.sharding.Sharding` matching the
+    `like_*` trees leaf-for-leaf) each leaf is built with
+    `jax.make_array_from_callback`: every device's shard assembles from
+    the overlapping saved pieces only, so an N-device checkpoint
+    restores onto an M-device mesh — N and M need not match, and no
+    global leaf is ever materialized on host.  Without shardings the
+    leaves assemble to full host arrays (the elastic-resume fallback).
+    `stats` (optional dict) records "max_piece_bytes" /
+    "max_region_bytes" / "pieces_read" — the proof of the working-set
+    bound."""
+    if not os.path.isdir(directory) and os.path.isdir(directory + ".bak"):
+        directory = directory + ".bak"
+    faults.fire("checkpoint.load", path=directory)
+    return _load_sharded_impl(directory, like_params, like_updater,
+                              params_shardings, updater_shardings, stats)
+
+
 def load(directory: str, like_params=None, like_updater=None
          ) -> Tuple[Any, Any, Dict[str, Any]]:
     """Read a checkpoint.  With `like_*` example pytrees the arrays are
@@ -203,10 +484,16 @@ def load(directory: str, like_params=None, like_updater=None
     if not os.path.isdir(directory) and os.path.isdir(directory + ".bak"):
         directory = directory + ".bak"
     faults.fire("checkpoint.load", path=directory)
-    with np.load(os.path.join(directory, "arrays.npz")) as z:
-        flat = {k: z[k] for k in z.files}
     with open(os.path.join(directory, "meta.json")) as f:
         meta = json.load(f)
+    if meta.get("layout") == "sharded":
+        # transparently readable through the gathered-layout API:
+        # leaves assemble to full host arrays (use `load_sharded` with
+        # target shardings to keep the working set shard-sized)
+        return _load_sharded_impl(directory, like_params, like_updater,
+                                  None, None, None)
+    with np.load(os.path.join(directory, "arrays.npz")) as z:
+        flat = {k: z[k] for k in z.files}
     version = int(meta.get("format_version", 0))
     if version > FORMAT_VERSION:
         raise CheckpointFormatError(

@@ -272,7 +272,8 @@ def zero1_pspecs(tree, mesh: Mesh, axis: str = "dp"):
 
 
 def make_zero1_train_step(conf: MultiLayerConfiguration, mesh: Mesh,
-                          axis: str = "dp", cache=None):
+                          axis: str = "dp", masked: bool = False,
+                          cache=None):
     """Data-parallel step with ZeRO-1 optimizer-state sharding, built on
     GSPMD sharding annotations instead of manual collectives: the batch
     is dp-sharded, params stay replicated, and the AdaGrad/momentum (or
@@ -285,17 +286,33 @@ def make_zero1_train_step(conf: MultiLayerConfiguration, mesh: Mesh,
     constraints rather than hand-written ppermutes.
 
     Use with `zero1_shard_state(state, mesh)`; step signature matches
-    `make_dp_train_step` (state, x, y, key) -> (state, score)."""
+    `make_dp_train_step` (state, x, y, key) -> (state, score).
+
+    masked=True is the pad-and-mask remainder-batch variant (ISSUE 17
+    closing PR 10's guard): signature (state, x, y, w, key), per-label-
+    row weights, loss = dot(rows, w) / max(sum(w), 1) + reg.  Because
+    this is the GSPMD path the weighted mean is one whole-array
+    contraction (no per-shard psum), so a zero-padded tail batch scores
+    and steps on exactly the real rows — divisible batches never route
+    here and stay bitwise-identical to the unmasked step."""
     out_conf = conf.conf(conf.n_layers - 1)
     collect_bn = has_batchnorm(conf)
     if collect_bn:
         raise ValueError("zero1 step does not support BatchNorm nets "
                          "(per-batch stats need the shard_map path)")
 
-    def step_fn(state: TrainState, x, y, key):
+    def step_fn(state: TrainState, x, y, *rest):
+        (w, key) = rest if masked else (None, rest[0])
+
         def loss_fn(p, k):
-            rows = network_rowwise_loss(conf, p, x, y, k, training=True)
-            return jnp.mean(rows) + network_regularization(conf, p)
+            wx = None if w is None else _feature_row_weights(w, x)
+            rows = network_rowwise_loss(conf, p, x, y, k, training=True,
+                                        row_weights=wx)
+            if w is None:
+                return jnp.mean(rows) + network_regularization(conf, p)
+            den = jnp.maximum(jnp.sum(w), 1.0)
+            return (jnp.dot(rows, w) / den
+                    + network_regularization(conf, p))
 
         score, grads = jax.value_and_grad(loss_fn)(state.params, key)
         # pin the gradient layout to the updater's sharded layout: the
@@ -317,7 +334,7 @@ def make_zero1_train_step(conf: MultiLayerConfiguration, mesh: Mesh,
 
     jitted = jax.jit(step_fn, donate_argnums=(0,))
     if cache is not None:
-        return cache.track_jit(("zero1_step", axis), jitted)
+        return cache.track_jit(("zero1_step", axis, masked), jitted)
     return jitted
 
 
@@ -341,6 +358,95 @@ def zero1_shard_state(state: TrainState, mesh: Mesh, axis: str = "dp"):
                           adagrad_hist=put_sharded(state.updater.adagrad_hist),
                           velocity=put_sharded(state.updater.velocity)),
                       step=jax.device_put(state.step, rep))
+
+
+def make_plan_train_step(conf: MultiLayerConfiguration, plan,
+                         masked: bool = False, zero1: bool = False,
+                         cache=None):
+    """GSPMD training step driven by a `parallel.plan.ShardPlan` with a
+    `model` axis (ISSUE 17): params tensor-shard per the plan's
+    per-leaf specs (QKV/FFN-up/embedding column-split, Wo/FFN-down
+    row-split), the batch shards over the plan's batch axis, and jit
+    inserts the collectives — the all-reduce after every row-split
+    matmul AND the dp gradient reduction come out of one partitioner
+    pass.  Updater moments follow the params' model split; zero1=True
+    additionally shards their first batch-divisible dim over the batch
+    axis (`plan.zero1_pspecs` — both axes on one leaf where divisible).
+    masked=True is the pad-and-mask remainder variant ((state, x, y, w,
+    key), weight-0 pad rows, dot-form weighted mean).
+
+    Use with `plan_shard_state`; signatures match
+    `make_zero1_train_step`."""
+    out_conf = conf.conf(conf.n_layers - 1)
+    if has_batchnorm(conf):
+        raise ValueError("plan step does not support BatchNorm nets "
+                         "(per-batch stats need the shard_map path)")
+    mesh = plan.mesh
+    batch_spec = P(plan.batch_axis if plan.batch_axis in mesh.axis_names
+                   else None)
+
+    def pin(tree, specs):
+        return jax.tree_util.tree_map(
+            lambda a, s: jax.lax.with_sharding_constraint(
+                a, NamedSharding(mesh, s)), tree, specs)
+
+    def step_fn(state: TrainState, x, y, *rest):
+        (w, key) = rest if masked else (None, rest[0])
+        x = jax.lax.with_sharding_constraint(
+            x, NamedSharding(mesh, batch_spec))
+        params = pin(state.params, plan.param_pspecs(state.params))
+
+        def loss_fn(p, k):
+            wx = None if w is None else _feature_row_weights(w, x)
+            rows = network_rowwise_loss(conf, p, x, y, k, training=True,
+                                        row_weights=wx)
+            if w is None:
+                return jnp.mean(rows) + network_regularization(conf, p)
+            den = jnp.maximum(jnp.sum(w), 1.0)
+            return (jnp.dot(rows, w) / den
+                    + network_regularization(conf, p))
+
+        score, grads = jax.value_and_grad(loss_fn)(params, key)
+        gspec_fn = plan.zero1_pspecs if zero1 else plan.param_pspecs
+        grads = pin(grads, gspec_fn(grads))
+        adj, upd = adjust_gradient(out_conf, state.step, grads,
+                                   params, state.updater)
+        new_params = jax.tree_util.tree_map(
+            lambda p, a: p - a.astype(p.dtype), params, adj)
+        # params stay model-sharded across steps (never gathered); only
+        # the zero1 batch-axis split of the step all-gathers back
+        new_params = pin(new_params, plan.param_pspecs(new_params))
+        return TrainState(new_params, upd, state.step + 1), score
+
+    jitted = jax.jit(step_fn, donate_argnums=(0,))
+    if cache is not None:
+        return cache.track_jit(
+            ("plan_step", plan.sharding_tag(), masked, zero1), jitted)
+    return jitted
+
+
+def plan_shard_state(state: TrainState, plan, zero1: bool = False
+                     ) -> TrainState:
+    """Place a TrainState per a model-axis ShardPlan: params and updater
+    moments tensor-sharded per leaf (zero1 composes the batch axis into
+    the moments), step replicated — no leaf lives at global size on any
+    one chip."""
+    mesh = plan.mesh
+
+    def put(tree, specs):
+        return jax.tree_util.tree_map(
+            lambda a, s: jax.device_put(a, NamedSharding(mesh, s)),
+            tree, specs)
+
+    uspec_fn = plan.zero1_pspecs if zero1 else plan.param_pspecs
+    return TrainState(
+        params=put(state.params, plan.param_pspecs(state.params)),
+        updater=UpdaterState(
+            adagrad_hist=put(state.updater.adagrad_hist,
+                             uspec_fn(state.updater.adagrad_hist)),
+            velocity=put(state.updater.velocity,
+                         uspec_fn(state.updater.velocity))),
+        step=jax.device_put(state.step, NamedSharding(mesh, P())))
 
 
 def param_pspecs(params, mesh: Mesh, tp_axis: str = "tp"):
@@ -498,12 +604,30 @@ class DataParallelTrainer:
                      (`make_zero1_train_step`); checkpoints gather them
                      to full shape on save and re-shard on load, so the
                      same elastic resume covers them
+    plan=ShardPlan   a `parallel.plan.ShardPlan` with a `model` axis
+                     switches to the tensor-parallel GSPMD step
+                     (`make_plan_train_step`): params + updater moments
+                     shard per-leaf, batches over the plan's batch axis
+                     (zero1 composes), and checkpoints write the SHARDED
+                     layout — no global leaf ever materializes
     """
 
-    def __init__(self, net: MultiLayerNetwork, mesh: Mesh,
+    def __init__(self, net: MultiLayerNetwork, mesh: Optional[Mesh] = None,
                  mode: str = "sync", local_steps: int = 5,
                  axis: str = "dp", listeners=(), grad_accum: int = 1,
-                 zero1: bool = False):
+                 zero1: bool = False, plan=None):
+        self.plan = plan
+        self._plan_tp = bool(plan is not None
+                             and getattr(plan, "has_model_axis", False))
+        if self._plan_tp:
+            mesh = plan.mesh
+            axis = plan.batch_axis
+        elif mesh is None:
+            if plan is not None:
+                mesh = plan.mesh  # 1-D plan: the plain dp path
+                axis = plan.batch_axis
+            else:
+                raise ValueError("pass mesh= or plan=")
         self.net = net
         self.mesh = mesh
         self.axis = axis
@@ -519,7 +643,15 @@ class DataParallelTrainer:
 
         self.compile_cache = CompiledProgramCache()
         self.compile_cache.kind = "dp-step-cache"
-        if zero1:
+        if self._plan_tp:
+            if mode != "sync":
+                raise ValueError("a model-axis plan requires mode='sync'")
+            if grad_accum > 1:
+                raise ValueError("a model-axis plan does not compose "
+                                 "with grad_accum yet")
+            self._step = make_plan_train_step(net.conf, plan, zero1=zero1,
+                                              cache=self.compile_cache)
+        elif zero1:
             if mode != "sync":
                 raise ValueError("zero1=True requires mode='sync' (the "
                                  "averaging round replicates its carry)")
@@ -544,7 +676,9 @@ class DataParallelTrainer:
         self._grad_accum = grad_accum
         self._masked_step = None  # built lazily on first remainder batch
         self.state = init_train_state(net)
-        if zero1:
+        if self._plan_tp:
+            self.state = plan_shard_state(self.state, plan, zero1)
+        elif zero1:
             self.state = zero1_shard_state(self.state, mesh, axis)
         self._key = jax.random.PRNGKey(net.conf.confs[0].seed or 0)
         # crash-safety bookkeeping (fit(checkpoint_dir=...)): SIGTERM flag
@@ -596,7 +730,13 @@ class DataParallelTrainer:
         sharding tree for the NEW mesh re-places every leaf, so a
         checkpoint written on N chips trains on M.  Params and step
         replicate; updater state replicates too, or re-shards over the
-        dp axis in zero1 mode."""
+        dp axis in zero1 mode; a model-axis plan re-shards everything
+        per its per-leaf specs."""
+        if self._plan_tp:
+            return plan_shard_state(
+                TrainState(params=state.params, updater=state.updater,
+                           step=jnp.asarray(state.step, jnp.int32)),
+                self.plan, self.zero1)
         if self.zero1:
             return zero1_shard_state(
                 TrainState(params=state.params, updater=state.updater,
@@ -647,12 +787,16 @@ class DataParallelTrainer:
         from deeplearning4j_tpu.parallel import checkpoint as ckpt
 
         t0 = time.perf_counter()
-        ckpt.save(directory, self.state.params, self.state.updater,
-                  conf=self.net.conf, step=int(self.state.step),
-                  data_cursor={"batches_done": int(batches_done)},
-                  metadata={"rng_key": np.asarray(
-                      jax.device_get(self._key)).tolist()},
-                  mesh=self.mesh_meta())
+        # a model-axis plan writes the SHARDED layout (one piece per
+        # unique shard — no global leaf on host); `load`/`load_resilient`
+        # read both layouts, so resume is unchanged
+        writer = ckpt.save_sharded if self._plan_tp else ckpt.save
+        writer(directory, self.state.params, self.state.updater,
+               conf=self.net.conf, step=int(self.state.step),
+               data_cursor={"batches_done": int(batches_done)},
+               metadata={"rng_key": np.asarray(
+                   jax.device_get(self._key)).tolist()},
+               mesh=self.mesh_meta())
         self.checkpoint_write_seconds += time.perf_counter() - t0
         self.checkpoints_written += 1
 
@@ -679,7 +823,15 @@ class DataParallelTrainer:
                 log.warning(
                     "remainder batch of %d runs the masked step WITHOUT "
                     "grad_accum=%d (single fwd/bwd)", b, self._grad_accum)
-            if self.mode == "sync":
+            if self._plan_tp:
+                self._masked_step = make_plan_train_step(
+                    self.net.conf, self.plan, masked=True,
+                    zero1=self.zero1, cache=self.compile_cache)
+            elif self.zero1:
+                self._masked_step = make_zero1_train_step(
+                    self.net.conf, self.mesh, self.axis, masked=True,
+                    cache=self.compile_cache)
+            elif self.mode == "sync":
                 self._masked_step = make_masked_dp_train_step(
                     self.net.conf, self.mesh, self.axis,
                     cache=self.compile_cache)
@@ -701,8 +853,8 @@ class DataParallelTrainer:
             checkpoint_every_n_batches: int = 0,
             auto_resume: bool = True) -> float:
         """data yields (features, labels) or DataSet; leading dim must be
-        divisible by the dp axis size (remainder batches pad-and-mask;
-        zero1 mode requires divisible batches).
+        divisible by the dp axis size (remainder batches pad-and-mask —
+        in every mode, including zero1 and plan steps).
 
         With `checkpoint_dir` the run is crash-safe AND elastic (ISSUE
         10): the complete cross-batch state — params, updater moments,
@@ -780,13 +932,9 @@ class DataParallelTrainer:
                         if hasattr(batch, "features") else batch)
                 x, y = jnp.asarray(x), jnp.asarray(y)
                 if x.shape[0] % n_dp:
-                    if self.zero1:
-                        raise ValueError(
-                            f"zero1 mode needs batches divisible by the "
-                            f"{n_dp}-wide dp axis, got {x.shape[0]} rows "
-                            f"(resize the batch or drop zero1)")
                     # pad-and-mask: every real sample still contributes
-                    # exactly once (no silent remainder drop)
+                    # exactly once (no silent remainder drop; zero1 and
+                    # plan modes route through their masked variants)
                     self.state, s = self._step_padded(x, y)
                 else:
                     x, y = shard_batch(self.mesh, (x, y), self.axis)
@@ -809,12 +957,21 @@ class DataParallelTrainer:
                         self._save_checkpoint(checkpoint_dir, n_done)
         if checkpoint_dir is not None and n_done > start_batch:
             self._save_checkpoint(checkpoint_dir, n_done)
-        # hand the net a single-device copy: the serve/train-path AOT
-        # programs compile for single-chip layouts, and an
-        # already-compiled executable can't reshard a mesh-replicated
-        # NamedSharding leaf the way plain jit would.  Replicated params
-        # make this a local device copy (async, no host roundtrip).
-        self.net.params = jax.tree_util.tree_map(
-            lambda a: jax.device_put(a, self.mesh.devices.flat[0]),
-            self.state.params)
+        if self._plan_tp:
+            # keep the tensor-sharded placement: gathering a model the
+            # plan exists to fit across chips would defeat it.  Copy so
+            # a later fit's donated steps can't delete the net's view;
+            # serving re-places per its own plan (`set_serve_mesh`).
+            self.net.params = jax.tree_util.tree_map(
+                jnp.copy, self.state.params)
+        else:
+            # hand the net a single-device copy: the serve/train-path AOT
+            # programs compile for single-chip layouts, and an
+            # already-compiled executable can't reshard a mesh-replicated
+            # NamedSharding leaf the way plain jit would.  Replicated
+            # params make this a local device copy (async, no host
+            # roundtrip).
+            self.net.params = jax.tree_util.tree_map(
+                lambda a: jax.device_put(a, self.mesh.devices.flat[0]),
+                self.state.params)
         return float(score) if score is not None else float("nan")

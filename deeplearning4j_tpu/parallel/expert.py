@@ -24,6 +24,7 @@ from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
 from deeplearning4j_tpu.parallel.sequence import _shard_map
+from deeplearning4j_tpu.reliability import faults
 
 
 def init_moe_params(key, d_model: int, d_hidden: int, n_experts: int,
@@ -105,14 +106,21 @@ def moe_ffn_dense(params, x, capacity_factor: float = 2.0):
     return x + y, aux
 
 
-def moe_ffn(params, x, mesh: Mesh, axis: str = "ep",
-            capacity_factor: float = 2.0):
+def moe_ffn(params, x, mesh: Optional[Mesh] = None, axis: str = "ep",
+            capacity_factor: float = 2.0, plan=None):
     """Expert-parallel MoE: tokens sharded over `axis`, experts too.
 
     x: [T, d] with T divisible by the axis size; n_experts divisible by the
     axis size.  Returns ([T, d], aux_loss averaged over shards).
+    mesh=None derives the mesh from `plan` (a `parallel.plan.ShardPlan`)
+    or from every platform device (`pipeline.resolve_stage_mesh`).
     """
+    from deeplearning4j_tpu.parallel.pipeline import resolve_stage_mesh
+
+    mesh = resolve_stage_mesh(mesh, plan, axis)
     n = mesh.shape[axis]
+    # host-side fault point, fired at dispatch-build (trace) time
+    faults.fire("expert.dispatch", axis=axis, shards=int(n))
     e = params["router"].shape[1]
     if e % n:
         raise ValueError(f"n_experts={e} not divisible by {axis}={n}")

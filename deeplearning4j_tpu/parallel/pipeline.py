@@ -17,26 +17,52 @@ over `pp`.
 
 from __future__ import annotations
 
-from typing import Callable
+from typing import Callable, Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
 from deeplearning4j_tpu.parallel.sequence import _as_varying, _shard_map
+from deeplearning4j_tpu.reliability import faults
 
 
-def pipeline_apply(fn: Callable, stage_params, x_micro, mesh: Mesh,
-                   axis: str = "pp"):
+def resolve_stage_mesh(mesh: Optional[Mesh], plan, axis: str) -> Mesh:
+    """The mesh a pipeline/expert stage runs on: an explicit mesh wins;
+    a ShardPlan reuses its mesh when it carries `axis`, else a 1-axis
+    mesh over the plan's devices; with neither, every platform device
+    (queried through the `nd.platform` choke point — never jax.devices
+    directly)."""
+    if mesh is not None:
+        return mesh
+    if plan is not None and plan.mesh is not None:
+        if axis in plan.mesh.axis_names:
+            return plan.mesh
+        return Mesh(plan.mesh.devices.reshape(-1), (axis,))
+    from deeplearning4j_tpu.nd import platform
+
+    return Mesh(np.asarray(platform.devices()), (axis,))
+
+
+def pipeline_apply(fn: Callable, stage_params, x_micro,
+                   mesh: Optional[Mesh] = None, axis: str = "pp",
+                   plan=None):
     """Run microbatches through the stage pipeline.
 
     fn(params_one_stage, x) -> y with y.shape == x.shape.
     stage_params: pytree whose leaves have leading dim n_stages (sharded
     over `axis`).  x_micro: [n_micro, mb, ...] microbatched input
     (replicated).  Returns [n_micro, mb, ...] outputs (replicated).
+    mesh=None derives the mesh from `plan` (a `parallel.plan.ShardPlan`)
+    or from every platform device (`resolve_stage_mesh`).
     """
+    mesh = resolve_stage_mesh(mesh, plan, axis)
     n_stage = mesh.shape[axis]
+    # host-side fault point, fired at schedule-build (trace) time — the
+    # chaos harness's hook into pipeline construction
+    faults.fire("pipeline.stage", axis=axis, stages=int(n_stage))
     n_micro = x_micro.shape[0]
     shift = [(i, i + 1) for i in range(n_stage - 1)]
 
@@ -78,10 +104,14 @@ def pipeline_apply(fn: Callable, stage_params, x_micro, mesh: Mesh,
     return _shard_map(local, mesh, in_specs, P())(stage_params, x_micro)
 
 
-def make_pipeline_train_step(fn: Callable, loss_fn: Callable, mesh: Mesh,
-                             axis: str = "pp", lr: float = 0.1):
+def make_pipeline_train_step(fn: Callable, loss_fn: Callable,
+                             mesh: Optional[Mesh] = None, axis: str = "pp",
+                             lr: float = 0.1, plan=None):
     """SGD train step over the pipeline: grads flow back through the
-    ppermute schedule (GPipe backward), then stages update locally."""
+    ppermute schedule (GPipe backward), then stages update locally.
+    mesh=None derives the mesh from `plan` or the platform
+    (`resolve_stage_mesh`)."""
+    mesh = resolve_stage_mesh(mesh, plan, axis)
 
     def loss_of(params, x_micro, y_micro):
         out = pipeline_apply(fn, params, x_micro, mesh, axis)

@@ -128,6 +128,10 @@ DOCUMENTED_POINTS = {
                          "pool (serving/batcher.py)",
     "generate.prefix_lookup": "per prefix-cache probe during stream "
                               "admission (serving/batcher.py)",
+    "pipeline.stage": "per pipeline schedule build (trace time) in "
+                      "pipeline_apply (parallel/pipeline.py)",
+    "expert.dispatch": "per expert-parallel dispatch build (trace time) "
+                       "in moe_ffn (parallel/expert.py)",
 }
 
 _PLAN_RE = re.compile(

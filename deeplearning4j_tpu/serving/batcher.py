@@ -1047,11 +1047,17 @@ class ContinuousBatcher:
     def _prefix_digest(self, prompt: np.ndarray) -> str:
         """Cache key for a prompt's prefill: prompt tokens + conf
         fingerprint + max_seq (row-state shape) + serve policy — the
-        same dimensions that key the prefill program itself."""
+        same dimensions that key the prefill program itself.  A plan
+        with a `model` axis folds its decode tag in too (sharded rows
+        are laid out differently); 1-D/single-chip digests stay
+        byte-identical to their pre-plan form."""
         ic = self.net.infer_cache
         h = hashlib.sha256()
         h.update(ic._fingerprint(self.net.conf).encode())
         h.update(repr((self.max_seq, ic.policy)).encode())
+        tag = ic._decode_tag()
+        if tag != ic.SINGLE:
+            h.update(repr(tag).encode())
         h.update(np.ascontiguousarray(prompt, np.int32).tobytes())
         return h.hexdigest()
 

@@ -187,6 +187,63 @@ def test_undonated_kv_cache_via_cache_records():
     assert audit_cache(FakeCache([rec]), expect_donation=False) == []
 
 
+def test_replicated_large_leaf_rule():
+    """ISSUE 17: on a mesh whose shardings carry a `model` axis, any
+    param leaf >= threshold bytes left fully replicated is an error —
+    it re-caps per-chip memory at the single-chip bound."""
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    class FakeCache:
+        def __init__(self, recs):
+            self._recs = recs
+
+        def audit_records(self):
+            return list(self._recs)
+
+    devs = np.asarray(jax.devices())
+    if devs.size < 8:
+        pytest.skip("needs the 8 forced host devices")
+    mesh = Mesh(devs[:8].reshape(2, 4), ("batch", "model"))
+    rep = NamedSharding(mesh, P())
+    col = NamedSharding(mesh, P(None, "model"))
+
+    def aval(shape, s):
+        return jax.ShapeDtypeStruct(shape, jnp.float32, sharding=s)
+
+    def rec(params_aval):
+        return {"key": ("output", "fp", ((8, 4), "float32"),
+                        ("mesh", ("batch", "model"), (2, 4))),
+                "kind": "infer-cache",
+                "build": lambda: (lambda p, x: x),
+                "abstract": ({"W": params_aval},
+                             aval((8, 4), NamedSharding(mesh,
+                                                        P("batch")))),
+                "donate_argnums": (), "mesh": True,
+                "shardings": ({"W": rep}, rep)}
+
+    # large replicated param on a model-axis mesh: flagged as error
+    fs = audit_cache(FakeCache([rec(aval((16, 16), rep))]),
+                     replicated_leaf_threshold=256)
+    assert "replicated-large-leaf" in _rules(fs)
+    assert any(f.severity == "error" for f in fs
+               if f.rule == "replicated-large-leaf")
+    # model-sharded leaf of the same size: clean
+    fs = audit_cache(FakeCache([rec(aval((16, 16), col))]),
+                     replicated_leaf_threshold=256)
+    assert "replicated-large-leaf" not in _rules(fs)
+    # below the threshold: clean (biases stay replicated by design)
+    fs = audit_cache(FakeCache([rec(aval((16, 16), rep))]),
+                     replicated_leaf_threshold=1 << 20)
+    assert "replicated-large-leaf" not in _rules(fs)
+    # no model axis anywhere in the shardings: rule stays silent
+    one_d = Mesh(devs[:8], ("batch",))
+    r = rec(aval((16, 16), NamedSharding(one_d, P())))
+    r["shardings"] = ({"W": NamedSharding(one_d, P())},
+                     NamedSharding(one_d, P("batch")))
+    fs = audit_cache(FakeCache([r]), replicated_leaf_threshold=256)
+    assert "replicated-large-leaf" not in _rules(fs)
+
+
 def test_decode_structure_audit_is_clean():
     """The compiled decode step must stay [S,S]-free at a cache length
     where a full-scores materialization is unambiguous (the ISSUE 14

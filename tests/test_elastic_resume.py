@@ -310,11 +310,25 @@ def test_zero1_elastic_trajectory_matches_plain_dp(tmp_path):
                                rtol=1e-4, atol=1e-5)
 
 
-def test_zero1_rejects_remainder_batches():
+def test_zero1_pads_and_masks_remainder_batches():
+    """ISSUE 17 closes PR 10's guard: a non-dp-divisible batch in zero1
+    mode pads-and-masks instead of raising, and updates on the divisible
+    prefix stay bitwise identical to a run that never saw the tail."""
+    batches = _batches(n=16, bs=8)
+    x, y = _batches(n=8, bs=8, seed=9)[0]
+    tail = (x[:6], y[:6])  # 6 rows on dp=4: pad to 8, mask 2
+
+    t_ref = DataParallelTrainer(_net(), _mesh(4), zero1=True)
+    t_ref.fit(batches, epochs=1)
+
     t = DataParallelTrainer(_net(), _mesh(4), zero1=True)
-    x, y = _batches(n=8, bs=8)[0]
-    with pytest.raises(ValueError, match="zero1 mode needs batches"):
-        t.fit([(x[:6], y[:6])], epochs=1)
+    t.fit(batches, epochs=1)
+    prefix = _gather(t.state.params)
+    assert _trees_equal(prefix, _gather(t_ref.state.params))
+
+    t.fit([tail], epochs=1)  # must not raise
+    assert int(t.state.step) == 3  # the remainder batch really stepped
+    assert not _trees_equal(prefix, _gather(t.state.params))
 
 
 def test_zero1_requires_sync_mode():
