@@ -11,6 +11,14 @@ wired into `python -m deeplearning4j_tpu.cli analyze`.
 
 Rules (rule id — severity — what it catches):
 
+  hardcoded-tunable       warn   a numeric/dict literal at a known
+                                 tunable call-site (attention blocks,
+                                 flush deadlines, slot/page geometry,
+                                 prefetch depth, batch targets) outside
+                                 optimize/tunables.py — registry-owned
+                                 values must resolve through the
+                                 TunedTable layer so `cli tune` winners
+                                 actually apply
   platform-sniff          error  `jax.devices()` / `jax.local_devices()`
                                  / `jax.device_count()` /
                                  `jax.default_backend()` / xla_bridge
@@ -124,6 +132,74 @@ def _attr_chain(node: ast.AST) -> Optional[str]:
 
 def _in_scope(relpath: str, scopes: Sequence[str]) -> bool:
     return any(relpath == s or relpath.startswith(s) for s in scopes)
+
+
+#: the one module allowed to define tunable constants (the registry's
+#: defaults); numeric literals at tunable call-sites anywhere else
+#: bypass `cli tune`'s TunedTable override layer
+TUNABLE_HOME = "optimize/tunables.py"
+
+#: constant names the registry now owns — re-declaring one with a
+#: literal value resurrects a hand-tuned constant
+_TUNABLE_CONST_NAMES = {"DEFAULT_TARGET_ROWS", "_BLOCK_TABLE",
+                        "ATTENTION_BLOCK_TABLE"}
+
+#: tunable-governed parameters: a numeric literal passed (or defaulted)
+#: for one of these pins a value the tuned table can no longer move
+_TUNABLE_KWARGS = {"max_delay_ms", "block_q", "block_k", "block_q_bwd",
+                   "block_k_bwd", "buffer_batches", "n_slots", "slots",
+                   "gen_slots", "page_size", "gen_page_size",
+                   "target_rows", "prefetch_depth"}
+
+
+def _rule_hardcoded_tunable(tree: ast.AST, relpath: str) -> List[Finding]:
+    """warn: a numeric/dict literal at a known tunable call-site outside
+    `optimize/tunables.py` (the registry defaults).  Deliberate pins are
+    fine — waive them with `# lint: allow(hardcoded-tunable)` so the
+    exception is reviewed."""
+    if relpath == TUNABLE_HOME:
+        return []
+
+    def numeric(node) -> bool:
+        return (isinstance(node, ast.Constant)
+                and type(node.value) in (int, float))
+
+    out = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name) and \
+                        tgt.id in _TUNABLE_CONST_NAMES and \
+                        (numeric(node.value) or
+                         isinstance(node.value, (ast.Dict, ast.Tuple))):
+                    out.append(Finding(
+                        "hardcoded-tunable", "warn", _loc(relpath, node),
+                        f"literal {tgt.id} outside {TUNABLE_HOME} — this "
+                        f"constant is registry-owned; resolve it through "
+                        f"optimize.tunables instead"))
+        elif isinstance(node, ast.Call):
+            for kw in node.keywords:
+                if kw.arg in _TUNABLE_KWARGS and numeric(kw.value):
+                    out.append(Finding(
+                        "hardcoded-tunable", "warn", _loc(relpath, node),
+                        f"numeric literal for tunable-governed "
+                        f"`{kw.arg}=` — pass None (tunable-resolved) or "
+                        f"waive a deliberate pin"))
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            a = node.args
+            pairs = list(zip(a.args[len(a.args) - len(a.defaults):],
+                             a.defaults))
+            pairs += [(arg, d) for arg, d in
+                      zip(a.kwonlyargs, a.kw_defaults) if d is not None]
+            for arg, default in pairs:
+                if arg.arg in _TUNABLE_KWARGS and numeric(default):
+                    out.append(Finding(
+                        "hardcoded-tunable", "warn",
+                        f"{relpath}:{default.lineno}",
+                        f"numeric default for tunable-governed parameter "
+                        f"`{arg.arg}` — default to None and resolve via "
+                        f"optimize.tunables"))
+    return out
 
 
 # -- per-node rules ----------------------------------------------------------
@@ -522,6 +598,7 @@ def lint_source(src: str, relpath: str = "<memory>",
                   else documented_points)
     findings: List[Finding] = []
     findings += _rule_platform_sniff(tree, relpath)
+    findings += _rule_hardcoded_tunable(tree, relpath)
     findings += _rule_wall_clock(tree, relpath)
     findings += _rule_f64(tree, relpath)
     findings += _rule_fault_point(tree, relpath, documented)

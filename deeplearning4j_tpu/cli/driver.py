@@ -427,6 +427,47 @@ def cmd_predict(args) -> int:
     return 0
 
 
+def _tuning_status() -> dict:
+    """The autotuning observability block (warmup/serve/tune JSON and
+    /v1/stats all report the same shape)."""
+    from deeplearning4j_tpu.optimize import tunables
+
+    return tunables.status()
+
+
+def cmd_tune(args) -> int:
+    """Search the tunables registry's config space for this model
+    (optimize/tune.py): measure real compiled candidate programs through
+    the existing caches, prune analytically-bad candidates, persist the
+    winning table in the compile cache keyed by (conf fingerprint,
+    device kind) — later warmup/serve/replica processes inherit it with
+    fresh_tunes == 0."""
+    from deeplearning4j_tpu.nn.conf import MultiLayerConfiguration
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.optimize import tune as tune_mod
+
+    import os
+
+    if args.model and os.path.isdir(args.model):
+        net = _load_model(args.model)
+    elif args.model:
+        with open(args.model) as f:
+            conf = MultiLayerConfiguration.from_json(f.read())
+        net = MultiLayerNetwork(conf).init()
+    else:
+        raise SystemExit("tune needs --model <conf.json | checkpoint dir>")
+    store = None
+    if args.compile_cache:
+        store = net.set_compile_cache(args.compile_cache)
+    groups = tuple(g.strip() for g in args.groups.split(",") if g.strip())
+    report = tune_mod.tune_and_store(
+        net, store, force=args.force, groups=groups, rounds=args.rounds,
+        seed=args.seed, max_seq=args.gen_max_seq)
+    report["disk_cache"] = _disk_stats(net)
+    print(json.dumps(report))
+    return 0
+
+
 def cmd_warmup(args) -> int:
     """Precompile declared shape buckets into a persistent compile cache
     so a later serving/training process starts from disk hits instead of
@@ -473,6 +514,7 @@ def cmd_warmup(args) -> int:
     summary["precision"] = net.serve_precision
     summary["mesh_devices"] = mesh_devices
     summary["disk_cache"] = _disk_stats(net)
+    summary["tuning"] = _tuning_status()
     print(json.dumps(summary))
     return 0
 
@@ -523,7 +565,7 @@ def _warm_generate(net, args, draft=None) -> dict:
     summary = net.warmup_generate(
         slots=args.gen_slots, max_seq=args.gen_max_seq,
         prompt_buckets=_parse_buckets(args.gen_prompt_buckets),
-        page_size=getattr(args, "gen_page_size", 0),
+        page_size=getattr(args, "gen_page_size", None),
         n_pages=getattr(args, "gen_pages", 0),
         prefix_cache=getattr(args, "gen_prefix_cache", False),
         draft_net=draft,
@@ -555,7 +597,8 @@ def cmd_generate(args) -> int:
                          f"--gen-max-seq > {len(prompt)}")
     bucket = max(4, 1 << (len(prompt) - 1).bit_length())
     draft = _gen_draft_net(args)
-    net.warmup_generate(slots=1, max_seq=args.gen_max_seq,
+    # one-shot generation deliberately pins a single decode slot
+    net.warmup_generate(slots=1, max_seq=args.gen_max_seq,  # lint: allow(hardcoded-tunable)
                         prompt_buckets=(min(bucket, args.gen_max_seq),),
                         page_size=getattr(args, "gen_page_size", 0),
                         prefix_cache=getattr(args, "gen_prefix_cache",
@@ -563,7 +606,8 @@ def cmd_generate(args) -> int:
                         draft_net=draft,
                         spec_k=getattr(args, "gen_spec_k", 0))
     warmed_misses = net.infer_cache.stats.misses
-    batcher = ContinuousBatcher(net, n_slots=1, max_seq=args.gen_max_seq,
+    batcher = ContinuousBatcher(net, n_slots=1,  # lint: allow(hardcoded-tunable)
+                                max_seq=args.gen_max_seq,
                                 prompt_buckets=(min(bucket,
                                                     args.gen_max_seq),),
                                 page_size=getattr(args, "gen_page_size", 0),
@@ -638,13 +682,13 @@ def _build_server(args):
                                                    "default_deadline_ms",
                                                    None),
                        generate=generate,
-                       gen_slots=getattr(args, "gen_slots", 4),
+                       gen_slots=getattr(args, "gen_slots", None),
                        gen_max_seq=getattr(args, "gen_max_seq", 64),
                        gen_prompt_buckets=_parse_buckets(
                            getattr(args, "gen_prompt_buckets", "8"))
                        if generate else (8,),
                        gen_max_pending=getattr(args, "gen_max_pending", 64),
-                       gen_page_size=getattr(args, "gen_page_size", 0),
+                       gen_page_size=getattr(args, "gen_page_size", None),
                        gen_pages=getattr(args, "gen_pages", 0),
                        gen_prefix_cache=getattr(args, "gen_prefix_cache",
                                                 False),
@@ -659,7 +703,8 @@ def _build_server(args):
                "precision": net.serve_precision,
                "precision_report": precision_report,
                "generation": gen_warmed,
-               "disk_cache": _disk_stats(net)}
+               "disk_cache": _disk_stats(net),
+               "tuning": _tuning_status()}
     return net, server, summary
 
 
@@ -702,10 +747,13 @@ def _replica_cmd(args) -> List[str]:
     cmd = [sys.executable, "-m", "deeplearning4j_tpu.cli", "serve",
            "--model", args.model, "--host", args.host, "--port", "0",
            "--shapes", args.shapes,
-           "--max-delay-ms", str(args.max_delay_ms),
            "--max-pending", str(args.max_pending),
            "--drain-timeout", str(getattr(args, "drain_timeout", 10.0)),
            "--request-timeout", str(getattr(args, "request_timeout", 30.0))]
+    if args.max_delay_ms is not None:
+        # None = tunable-governed; each replica resolves its own (and a
+        # shared tuned table keeps the fleet uniform)
+        cmd += ["--max-delay-ms", str(args.max_delay_ms)]
     if args.compile_cache:
         cmd += ["--compile-cache", args.compile_cache]
     if args.max_batch_rows is not None:
@@ -927,10 +975,11 @@ def _add_generate_flags(p: argparse.ArgumentParser) -> None:
                    help="compile the autoregressive decode + prefill "
                         "programs; on serve, also run the continuous-"
                         "batching decode loop behind POST /v1/generate")
-    p.add_argument("--gen-slots", dest="gen_slots", type=int, default=4,
+    p.add_argument("--gen-slots", dest="gen_slots", type=int, default=None,
                    help="decode slot-table width: concurrent generation "
                         "streams per device call (one compiled decode "
-                        "step over the whole table)")
+                        "step over the whole table); default: the "
+                        "decode.slots tunable (4, or the tuned table)")
     p.add_argument("--gen-max-seq", dest="gen_max_seq", type=int,
                    default=64,
                    help="KV-cache length per slot; prompt + generated "
@@ -945,10 +994,11 @@ def _add_generate_flags(p: argparse.ArgumentParser) -> None:
                    help="queued generation streams bound; beyond it "
                         "submissions get 503")
     p.add_argument("--gen-page-size", dest="gen_page_size", type=int,
-                   default=0,
+                   default=None,
                    help="tokens per KV-cache page; > 0 switches decode "
                         "to the paged pool (memory scales with live "
-                        "tokens, not slots x max-seq)")
+                        "tokens, not slots x max-seq); default: the "
+                        "decode.page_size tunable (0 = contiguous)")
     p.add_argument("--gen-pages", dest="gen_pages", type=int, default=0,
                    help="physical KV pages in the pool (0 = enough for "
                         "every slot at full max-seq; smaller values "
@@ -1065,6 +1115,34 @@ def build_parser() -> argparse.ArgumentParser:
     _add_generate_flags(w)
     w.set_defaults(fn=cmd_warmup)
 
+    tu = sub.add_parser(
+        "tune",
+        help="search the tunables registry's config space (attention "
+             "blocks, batch targets, decode geometry) by measuring real "
+             "compiled programs; persist the winning table per (conf "
+             "fingerprint, device kind) in the compile cache")
+    tu.add_argument("--model", required=True,
+                    help="conf JSON or checkpoint dir to tune for")
+    tu.add_argument("--compile-cache", dest="compile_cache", default=None,
+                    metavar="DIR",
+                    help="persistent compile cache to store the tuned "
+                         "table in (and to inherit an existing one from "
+                         "— inherited tables report fresh_tunes == 0)")
+    tu.add_argument("--groups", default="attention,serve,decode",
+                    help="comma-separated tunable groups to search")
+    tu.add_argument("--rounds", type=int, default=3,
+                    help="timed rounds per candidate (min-of-rounds)")
+    tu.add_argument("--seed", type=int, default=0,
+                    help="rng seed for measurement inputs (the search "
+                         "is deterministic under a fixed seed)")
+    tu.add_argument("--gen-max-seq", dest="gen_max_seq", type=int,
+                    default=64,
+                    help="KV-cache length for the decode-group sweep")
+    tu.add_argument("--force", action="store_true",
+                    help="re-search even when the store already holds a "
+                         "valid table for this (fingerprint, device kind)")
+    tu.set_defaults(fn=cmd_tune)
+
     g = sub.add_parser(
         "generate",
         help="autoregressive generation from a checkpoint through the "
@@ -1132,8 +1210,10 @@ def build_parser() -> argparse.ArgumentParser:
                    help="0 picks an ephemeral port (printed in the "
                         "startup JSON)")
     s.add_argument("--max-delay-ms", dest="max_delay_ms", type=float,
-                   default=3.0,
-                   help="how long a request may wait for batch co-riders")
+                   default=None,
+                   help="how long a request may wait for batch co-riders "
+                        "(default: the batcher.max_delay_ms tunable — "
+                        "3.0, or the tuned table)")
     s.add_argument("--max-pending", dest="max_pending", type=int,
                    default=1024,
                    help="queued-request bound; beyond it requests get 503")

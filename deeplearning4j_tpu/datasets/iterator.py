@@ -294,9 +294,14 @@ class PrefetchIterator:
     _ERROR = "error"
     _ITEM = "item"
 
-    def __init__(self, base, buffer_batches: int = 2, device=None,
-                 to_device: bool = True):
+    def __init__(self, base, buffer_batches: Optional[int] = None,
+                 device=None, to_device: bool = True):
+        from deeplearning4j_tpu.optimize import tunables
+
         self.base = base
+        # None -> the "data.prefetch_depth" tunable (registry default 2)
+        if buffer_batches is None:
+            buffer_batches = tunables.resolve("data.prefetch_depth")
         self.buffer_batches = max(1, int(buffer_batches))
         self.device = device
         self.to_device = to_device

@@ -56,39 +56,38 @@ def _interpret(flag: Optional[bool]) -> bool:
     return not is_tpu()
 
 
-# Measured block-size table for the flash kernels, keyed by (seq, head_dim).
-# Provenance: TPU v5 lite sweeps at BENCH_r02 shapes (block pairs within the
-# 16 MiB VMEM budget; larger K blocks amortize the loop overhead at long S,
-# larger Q blocks stop paying once the per-tile [block_q, block_k] f32
-# scores tile crowds out double-buffered K/V).  Values are
-# (fwd_q, fwd_k, bwd_q, bwd_k): the backward kernels keep more live state
-# per tile (q, dO, k, v plus two [block_q, block_k] f32 intermediates —
-# p and ds) so their sizes sit one notch smaller.  Entries not present fall
-# back to the heuristic below; re-run bench_transformer_mfu on new shapes
-# to extend the table.
-_BLOCK_TABLE = {
-    (256, 32): (128, 128, 128, 128),
-    (256, 64): (128, 128, 128, 128),
-    (512, 64): (128, 256, 128, 128),
-    (1024, 64): (128, 256, 128, 256),
-    (1024, 128): (128, 256, 128, 128),
-    (2048, 64): (256, 256, 128, 256),
-    (2048, 128): (256, 256, 128, 128),
-    (4096, 128): (256, 512, 128, 256),
-}
+def _block_table():
+    """The measured (seq, head_dim) -> (fwd_q, fwd_k, bwd_q, bwd_k)
+    defaults — moved to the tunables registry
+    (`optimize.tunables.ATTENTION_BLOCK_TABLE`, TPU v5 lite provenance at
+    BENCH_r02 shapes); lazy-imported because the kernel layer sits below
+    optimize/ in the import graph."""
+    from deeplearning4j_tpu.optimize import tunables
+
+    return tunables.ATTENTION_BLOCK_TABLE
 
 
 def pick_attention_blocks(seq: int, head_dim: int, bwd: bool = False) -> tuple:
     """(block_q, block_k) for `flash_attention` at this (S, head_dim).
 
-    Table hit -> measured sizes; miss -> largest power-of-two blocks that
-    divide S (the kernels require S % block == 0; ragged S falls back to
-    `blockwise_attention` anyway), capped at 256/512 to stay inside VMEM
-    with f32 scores tiles.  `bwd=True` returns the backward kernels' sizes,
-    capped one notch lower (128/256) because the dK/dV and dQ kernels hold
-    two [block_q, block_k] f32 intermediates (p and ds) live per tile.
+    Resolution order: tuned-table override (`optimize.tunables.resolve`,
+    qualified per "{seq}x{head_dim}" — installed by `cli tune` for this
+    device kind) -> the measured default table -> largest power-of-two
+    blocks that divide S (the kernels require S % block == 0; ragged S
+    falls back to `blockwise_attention` anyway), capped at 256/512 to
+    stay inside VMEM with f32 scores tiles.  `bwd=True` returns the
+    backward kernels' sizes, capped one notch lower (128/256) because the
+    dK/dV and dQ kernels hold two [block_q, block_k] f32 intermediates
+    (p and ds) live per tile.  With no tuned table installed the answer
+    is byte-identical to the historical `_BLOCK_TABLE` lookup.
     """
-    hit = _BLOCK_TABLE.get((seq, head_dim))
+    from deeplearning4j_tpu.optimize import tunables
+
+    name = "attention.block_bwd" if bwd else "attention.block_fwd"
+    tuned = tunables.resolve(name, "%dx%d" % (seq, head_dim))
+    if tuned is not None:
+        return tuple(tuned)
+    hit = _block_table().get((seq, head_dim))
     if hit is not None:
         return hit[2:] if bwd else hit[:2]
 
@@ -438,10 +437,16 @@ def _flash_fused_bwd_impl(q, k, v, out, lse, g, causal, block_q, block_k,
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9, 10))
-def flash_attention(q, k, v, causal: bool = False, block_q: int = 128,
-                    block_k: int = 128, interpret: Optional[bool] = None,
+# API-level fallbacks, not serving defaults: in-repo callers pass
+# blocks from pick_attention_blocks (the tunable-resolved site); 0 is
+# the bwd autotune sentinel
+def flash_attention(q, k, v, causal: bool = False,
+                    block_q: int = 128,  # lint: allow(hardcoded-tunable)
+                    block_k: int = 128,  # lint: allow(hardcoded-tunable)
+                    interpret: Optional[bool] = None,
                     block_skip: bool = False, fused_bwd: bool = False,
-                    block_q_bwd: int = 0, block_k_bwd: int = 0):
+                    block_q_bwd: int = 0,  # lint: allow(hardcoded-tunable)
+                    block_k_bwd: int = 0):  # lint: allow(hardcoded-tunable)
     """Flash attention: [B,S,H,D] inputs, Pallas forward, optional fused
     Pallas backward.
 

@@ -383,6 +383,16 @@ class MultiLayerNetwork:
         store = PersistentProgramStore(directory, **kw)
         self.step_cache.set_persist(store)
         self.infer_cache.set_persist(store)
+        # tuned-table inheritance (ISSUE 18): a table `cli tune` persisted
+        # for this (conf fingerprint, device kind) installs process-wide
+        # here, so replicas and future sessions serve with the tuned
+        # constants and fresh_tunes == 0.  Missing/corrupt/wrong-kind
+        # tables degrade to registry defaults inside load_and_install.
+        from deeplearning4j_tpu.optimize import tunables
+        from deeplearning4j_tpu.optimize.step_cache import conf_fingerprint
+
+        if tunables.active() is None:
+            tunables.load_and_install(store, conf_fingerprint(self.conf))
         return store
 
     def set_serve_mesh(self, mesh=None, spec=None):
@@ -535,9 +545,9 @@ class MultiLayerNetwork:
             "infer_cache": self.infer_cache.stats.as_dict(),
         }
 
-    def warmup_generate(self, slots: int = 4, max_seq: int = 64,
+    def warmup_generate(self, slots: Optional[int] = None, max_seq: int = 64,
                         prompt_buckets: Sequence[int] = (8,),
-                        page_size: int = 0, n_pages: int = 0,
+                        page_size: Optional[int] = None, n_pages: int = 0,
                         prefix_cache: bool = False, draft_net=None,
                         spec_k: int = 0):
         """Precompile the autoregressive generation programs (ISSUE 14)
@@ -558,6 +568,15 @@ class MultiLayerNetwork:
         the cache stats."""
         if self.params is None:
             self.init()
+        # None -> tunable-governed geometry, resolved exactly like
+        # ContinuousBatcher's own defaults so warmup and serving compile
+        # the same programs under a tuned table
+        from deeplearning4j_tpu.optimize import tunables
+
+        slots = int(tunables.resolve("decode.slots")
+                    if slots is None else slots)
+        page_size = (tunables.resolve("decode.page_size")
+                     if page_size is None else page_size)
         ic = self.infer_cache
         tok = jnp.zeros((slots,), jnp.int32)
         pos = jnp.zeros((slots,), jnp.int32)
@@ -626,14 +645,14 @@ class MultiLayerNetwork:
 
     # -- serving ------------------------------------------------------------
     def serve(self, host: str = "127.0.0.1", port: int = 0,
-              max_delay_ms: float = 3.0, max_pending: int = 1024,
+              max_delay_ms: Optional[float] = None, max_pending: int = 1024,
               max_batch_rows=None, batching: bool = True,
               request_timeout_s: float = 30.0,
               drain_timeout_s: float = 10.0,
               default_deadline_ms=None, breaker=None,
-              generate: bool = False, gen_slots: int = 4,
+              generate: bool = False, gen_slots: Optional[int] = None,
               gen_max_seq: int = 64, gen_prompt_buckets=(8,),
-              gen_max_pending: int = 64, gen_page_size: int = 0,
+              gen_max_pending: int = 64, gen_page_size: Optional[int] = None,
               gen_pages: int = 0, gen_prefix_cache: bool = False,
               gen_prefix_match: str = "exact", gen_draft=None,
               gen_spec_k: int = 0):

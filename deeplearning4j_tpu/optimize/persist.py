@@ -136,6 +136,12 @@ class PersistentProgramStore:
         self.vanished = 0        # entries a sibling process removed first
         self._io_warned = False  # warn ONCE, then count quietly
 
+    @property
+    def platform(self) -> dict:
+        """The platform facts this store's entries are valid for (copy;
+        tuned-table keying reads `device_kind` from here)."""
+        return dict(self._platform)
+
     def _note_io_error(self, op: str, path: str, exc: BaseException) -> None:
         """Count a disk-level failure (full disk, yanked NFS) that was
         downgraded to a plain cache miss.  One warning per store — a

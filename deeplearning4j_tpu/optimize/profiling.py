@@ -55,6 +55,23 @@ class OpCost(NamedTuple):
     bytes: float
 
 
+def attention_block_bytes(seq: int, head_dim: int, block_q: int,
+                          block_k: int, dtype_bytes: int = 4) -> float:
+    """HBM traffic of one flash-attention head at (block_q, block_k):
+    each of the S/bq Q tiles streams the full K and V ([S, D] each), Q
+    itself and the output are read/written once, and every (q, k) tile
+    pair touches a [bq, bk] f32 scores tile in VMEM.  This is the
+    autotuner's pruning signal (`optimize/tunables.py` cost hints):
+    relative cost across candidate blocks, not an absolute roofline —
+    halving block_q doubles the K/V streaming term, which is exactly the
+    2x the pruner cuts on."""
+    q_tiles = max(1, -(-seq // block_q))
+    stream = q_tiles * 2 * seq * head_dim           # K + V per Q tile
+    once = 2 * seq * head_dim                       # Q in, O out
+    scores = q_tiles * max(1, -(-seq // block_k)) * block_q * block_k
+    return float(dtype_bytes) * (stream + once + scores)
+
+
 def transformer_step_costs(*, batch: int, seq: int, d_model: int,
                            n_blocks: int, vocab: int, n_params: int,
                            dtype_bytes: int = 2,

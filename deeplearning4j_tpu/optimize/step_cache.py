@@ -176,8 +176,18 @@ class CompiledProgramCache:
     def bucket_rows(self, n: int) -> int:
         """Smallest known bucket >= n; otherwise n becomes a new bucket
         (fixed bucket sets never grow — an oversize batch runs unpadded
-        as its own bucket, logged)."""
+        as its own bucket, logged).  A tuned `infer.bucket_ladder`
+        (optimize/tunables.py) pre-seeds the grow-on-demand list; the
+        registry default is the empty ladder, which leaves this loop
+        byte-identical to the pre-registry behavior."""
+        from deeplearning4j_tpu.optimize import tunables
+
         with self._lock:
+            if not self._fixed_buckets:
+                for b in tunables.resolve("infer.bucket_ladder"):
+                    if int(b) not in self._buckets:
+                        self._buckets.append(int(b))
+                        self._buckets.sort()
             for b in self._buckets:
                 if b >= n:
                     return b

@@ -132,6 +132,12 @@ DOCUMENTED_POINTS = {
                       "pipeline_apply (parallel/pipeline.py)",
     "expert.dispatch": "per expert-parallel dispatch build (trace time) "
                        "in moe_ffn (parallel/expert.py)",
+    "tune.measure": "per candidate measurement in the autotuner search "
+                    "(optimize/tune.py); a failure skips the candidate "
+                    "(counted) and the search completes",
+    "tune.load": "tuned-table read from the disk compile cache "
+                 "(optimize/tunables.py); a failure degrades to registry "
+                 "defaults with one warning — serving never blocks",
 }
 
 _PLAN_RE = re.compile(

@@ -50,11 +50,15 @@ from typing import Deque, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from deeplearning4j_tpu.optimize import tunables
 from deeplearning4j_tpu.reliability import CircuitBreaker, DeadlineExceeded, faults
 
 #: coalescing target when no row bucket is known yet and the caller set
-#: no `max_batch_rows` cap
-DEFAULT_TARGET_ROWS = 256
+#: no `max_batch_rows` cap — now a registry default
+#: (`optimize/tunables.py`, "batcher.target_rows"); kept as a module
+#: constant for compat, but `_target_rows` resolves through the tuned
+#: table so `cli tune` winners apply without a restart
+DEFAULT_TARGET_ROWS = tunables.default("batcher.target_rows")
 
 #: rows/s is reported over this trailing window (seconds)
 RATE_WINDOW_S = 10.0
@@ -188,12 +192,16 @@ class MicroBatcher:
                     fake-clock breaker).
     """
 
-    def __init__(self, net, max_delay_ms: float = 3.0,
+    def __init__(self, net, max_delay_ms: Optional[float] = None,
                  max_pending: int = 1024,
                  max_batch_rows: Optional[int] = None,
                  auto_start: bool = True,
                  breaker: Optional[CircuitBreaker] = None):
         self.net = net
+        # None -> the tunable's effective value (tuned table if one is
+        # installed, else the registry default of 3.0 ms)
+        if max_delay_ms is None:
+            max_delay_ms = tunables.resolve("batcher.max_delay_ms")
         self.max_delay_s = float(max_delay_ms) / 1000.0
         self.max_pending = int(max_pending)
         self.max_batch_rows = max_batch_rows
@@ -328,7 +336,9 @@ class MicroBatcher:
         fitting = [b for b in buckets if cap is None or b <= cap]
         if fitting:
             return max(fitting)
-        return cap if cap is not None else DEFAULT_TARGET_ROWS
+        if cap is not None:
+            return cap
+        return int(tunables.resolve("batcher.target_rows"))
 
     def _oldest_key(self):
         """The queue holding the longest-waiting request (FIFO across
@@ -571,6 +581,9 @@ class MicroBatcher:
             "degraded": breaker["state"] != CircuitBreaker.CLOSED,
             "breaker": breaker,
             "priorities": priorities,
+            # autotuning state: tuned-table presence + fresh_tunes (a
+            # warm process that inherited its table from disk shows 0)
+            "tuning": tunables.status(),
         }
 
 
@@ -695,10 +708,10 @@ class ContinuousBatcher:
                     decode at any temperature.
     """
 
-    def __init__(self, net, n_slots: int = 4, max_seq: int = 64,
+    def __init__(self, net, n_slots: Optional[int] = None, max_seq: int = 64,
                  prompt_buckets: Tuple[int, ...] = (8,),
                  max_pending: int = 64, continuous: bool = True,
-                 auto_start: bool = True, page_size: int = 0,
+                 auto_start: bool = True, page_size: Optional[int] = None,
                  n_pages: int = 0, prefix_cache: bool = False,
                  prefix_match: str = "exact", draft_net=None,
                  spec_k: int = 0):
@@ -706,6 +719,13 @@ class ContinuousBatcher:
         from deeplearning4j_tpu.nn.conf import LayerType
 
         self.net = net
+        # None -> tunable-governed geometry ("decode.slots" /
+        # "decode.page_size"); explicit arguments always win so warmup
+        # and the batcher stay geometry-identical when the caller pins
+        if n_slots is None:
+            n_slots = tunables.resolve("decode.slots")
+        if page_size is None:
+            page_size = tunables.resolve("decode.page_size")
         self.n_slots = int(n_slots)
         self.max_seq = int(max_seq)
         self.prompt_buckets = tuple(sorted(

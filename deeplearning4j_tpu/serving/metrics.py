@@ -85,6 +85,8 @@ FAMILIES = {
     "dl4j_router_replica_healthy": ("gauge", ("replica",)),
     "dl4j_router_replica_breaker_state": ("gauge", ("replica",)),
     "dl4j_router_replica_stats_age_seconds": ("gauge", ("replica",)),
+    "dl4j_tuning_table_info": ("gauge", ("device_kind",)),
+    "dl4j_tuning_fresh_tunes_total": ("counter", ()),
     "dl4j_fleet_replicas": ("gauge", ("state",)),
     "dl4j_fleet_restarts_total": ("counter", ()),
     "dl4j_fleet_spawn_failures_total": ("counter", ()),
@@ -287,6 +289,20 @@ def replica_metrics(stats: dict, page: Optional[PrometheusText] = None,
     p.counter("dl4j_serving_cache_io_errors_total",
               "Disk-cache I/O errors downgraded to misses.",
               cache.get("io_errors", 0), lbl(policy=policy))
+    tuning = stats.get("tuning")
+    if tuning:
+        # info-style: the value is the installed-table count (0/1), the
+        # table's device kind rides as the label; fresh_tunes counts
+        # tunables searched in-process (0 on a warm inherit)
+        p.gauge("dl4j_tuning_table_info",
+                "Tuned tables installed (info-style gauge; the table's "
+                "device kind is the label).",
+                tuning.get("tuned_tables", 0),
+                lbl(device_kind=tuning.get("device_kind") or "none"))
+        p.counter("dl4j_tuning_fresh_tunes_total",
+                  "Tunables freshly searched in this process (a warm "
+                  "process inheriting its table from disk reports 0).",
+                  tuning.get("fresh_tunes", 0), lbl())
     gen = stats.get("generation")
     if gen:
         p.counter("dl4j_serving_tokens_total",

@@ -256,18 +256,20 @@ class ModelServer:
     """
 
     def __init__(self, net, host: str = "127.0.0.1", port: int = 0,
-                 max_delay_ms: float = 3.0, max_pending: int = 1024,
+                 max_delay_ms: Optional[float] = None,
+                 max_pending: int = 1024,
                  max_batch_rows: Optional[int] = None,
                  batching: bool = True,
                  request_timeout_s: float = 30.0,
                  drain_timeout_s: float = 10.0,
                  default_deadline_ms: Optional[float] = None,
                  breaker: Optional[CircuitBreaker] = None,
-                 generate: bool = False, gen_slots: int = 4,
+                 generate: bool = False,
+                 gen_slots: Optional[int] = None,
                  gen_max_seq: int = 64,
                  gen_prompt_buckets=(8,),
                  gen_max_pending: int = 64,
-                 gen_page_size: int = 0, gen_pages: int = 0,
+                 gen_page_size: Optional[int] = None, gen_pages: int = 0,
                  gen_prefix_cache: bool = False,
                  gen_prefix_match: str = "exact",
                  gen_draft=None, gen_spec_k: int = 0):

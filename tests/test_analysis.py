@@ -320,6 +320,37 @@ def test_f64_literal_and_default_dtype_rules():
     assert _rules(lint_source(kw, "nd/x.py")) == ["f64-literal"]
 
 
+def test_hardcoded_tunable_rule_both_directions():
+    # direction 1: literals at known tunable sites are flagged (warn)
+    const = "DEFAULT_TARGET_ROWS = 256\n"
+    fs = lint_source(const, "serving/x.py")
+    assert _rules(fs) == ["hardcoded-tunable"]
+    assert fs[0].severity == "warn"
+    table = "_BLOCK_TABLE = {(256, 64): (128, 128, 128, 128)}\n"
+    assert _rules(lint_source(table, "nd/x.py")) == ["hardcoded-tunable"]
+    call = "b = MicroBatcher(net, max_delay_ms=3.0)\n"
+    assert _rules(lint_source(call, "serving/x.py")) == ["hardcoded-tunable"]
+    sig = "def f(net, n_slots: int = 4):\n    pass\n"
+    assert _rules(lint_source(sig, "serving/x.py")) == ["hardcoded-tunable"]
+    # direction 2: the registry home, None-resolved defaults, variable
+    # pass-through, and waived deliberate pins are all clean
+    assert lint_source(const, "optimize/tunables.py") == []
+    clean = ("def f(net, n_slots=None):\n"
+             "    b = MicroBatcher(net, max_delay_ms=delay)\n")
+    assert lint_source(clean, "serving/x.py") == []
+    waived = ("b = ContinuousBatcher(net, n_slots=1)"
+              "  # lint: allow(hardcoded-tunable)\n")
+    assert lint_source(waived, "cli/x.py") == []
+
+
+def test_hardcoded_tunable_repo_passes_clean_after_migration():
+    # the migration moved every registry-owned constant into
+    # optimize/tunables.py; any remaining pin is an explicit waiver
+    from deeplearning4j_tpu.analysis.repo_lint import package_root
+    fs, _ = lint_package(package_root())
+    assert [f for f in fs if f.rule == "hardcoded-tunable"] == []
+
+
 def test_fault_point_rule_directions():
     doc = {"a.b": "doc"}
     ok = 'from x import faults\nfaults.fire("a.b")\n'
