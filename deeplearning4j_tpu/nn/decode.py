@@ -69,6 +69,10 @@ GENERATIVE_HIDDEN = (LayerType.LSTM, LayerType.GRAVES_LSTM,
 
 _RECURRENT = (LayerType.LSTM, LayerType.GRAVES_LSTM)
 
+#: token emitted by `decode_block` for scan steps a row sat frozen
+#: (its `rem` budget exhausted mid-block) — never a valid token id
+BLOCK_SENTINEL = -1
+
 
 def check_generative(conf: MultiLayerConfiguration):
     """Validate that `conf` is a decodable generative stack and return
@@ -237,6 +241,68 @@ def decode_step_paged(conf: MultiLayerConfiguration, params, state, tok,
     probs = OutputLayer.forward(params[len(types) - 1], out_conf, x)
     new_state.append({})
     return jnp.log(jnp.clip(probs, 1e-9, 1.0)), tuple(new_state)
+
+
+def decode_block(conf: MultiLayerConfiguration, params, state, tok, pos,
+                 keys, temps, rem, k: int, sample, page_table=None):
+    """Fused multi-step decode (ISSUE 19): advance every row up to `k`
+    tokens in ONE program — a `lax.scan` whose body is exactly
+    `decode_step` (or `decode_step_paged` when `page_table` is given)
+    followed by the injected `sample(logp, keys, temps) -> (tok, keys)`
+    on-device sampler.  One host dispatch per K tokens instead of per
+    token; the token trajectory is bitwise-identical to K sequential
+    one-step calls for any K.
+
+    rem [B] int32 is each row's remaining token budget.  A row whose
+    budget hits 0 mid-block FREEZES: its tok/pos/key and recurrent
+    carries stop advancing (cheap [B]-shaped `where`s — no full-cache
+    select), and its scan outputs turn into `BLOCK_SENTINEL`.  Its K/V
+    cache needs no mask at all: with tok and pos frozen, the step
+    rewrites the SAME cache cell with bitwise-identical values
+    (deterministic math over identical inputs), so "stops mutating"
+    holds value-for-value, and for released paged rows the host's
+    page table already points every write at the inert scratch page.
+
+    The key-split discipline matches the one-step path exactly: the
+    sampler runs over the full batch every scan step, but a frozen
+    row's advanced key is discarded, so its key splits precisely once
+    per token it actually emitted — the same count K=1 decoding burns.
+
+    Returns (toks [k, B] int32 scan outputs, tok [B] (last real token
+    per row), keys [B, 2], state) — state LAST, the donation/TP
+    contract every decode-family program shares."""
+    types = check_generative(conf)
+
+    def body(carry, _):
+        st, t, p, ks, r = carry
+        active = r > 0
+        if page_table is None:
+            logp, st2 = decode_step(conf, params, st, t, p)
+        else:
+            logp, st2 = decode_step_paged(conf, params, st, t, p,
+                                          page_table)
+        t2, ks2 = sample(logp, ks, temps)
+        frozen = []
+        for i, lt in enumerate(types):
+            if lt in _RECURRENT:
+                frozen.append(
+                    {"h": jnp.where(active[:, None], st2[i]["h"],
+                                    st[i]["h"]),
+                     "c": jnp.where(active[:, None], st2[i]["c"],
+                                    st[i]["c"])})
+            else:
+                frozen.append(st2[i])
+        out = jnp.where(active, t2, jnp.int32(BLOCK_SENTINEL))
+        t3 = jnp.where(active, t2, t)
+        ks3 = jnp.where(active[:, None], ks2, ks)
+        p3 = jnp.where(active, p + 1, p)
+        r3 = jnp.where(active, r - 1, r)
+        return (tuple(frozen), t3, p3, ks3, r3), out
+
+    carry, toks = jax.lax.scan(
+        body, (state, tok, pos, keys, rem), xs=None, length=int(k))
+    state, tok, _, keys, _ = carry
+    return toks, tok, keys, state
 
 
 def _verify_chunk_impl(conf, params, state, toks, pos, page_table):

@@ -516,6 +516,61 @@ class InferCache(CompiledProgramCache):
         return fn(*self._decode_place(sp, state, tok, pos, keys, temps,
                                       page_table))
 
+    def decode_multi(self, conf, params, state, tok, pos, keys, temps,
+                     rem, k: int, compile_only: bool = False):
+        """Fused K-step decode (ISSUE 19): ONE program advances every
+        row up to `k` tokens — `lax.scan` over the decode step with
+        in-program sampling, bitwise the trajectory `k` sequential
+        `decode` calls produce.  rem [B] int32 is each row's remaining
+        token budget; rows exhausting it mid-block freeze and emit
+        `nn.decode.BLOCK_SENTINEL`.  Returns (toks [k, B] int32,
+        tok_last [B], keys [B, 2], new state).  K is folded into the
+        key's ENTRY name ("decode-multi[k]") so the (entry, sig, tag,
+        policy) key layout every summary/audit consumer parses is
+        unchanged.  Same donation/sharding contract as `decode`."""
+        policy, sp = self._policy, self._serve_params(params)
+        key = ("decode-multi[%d]" % int(k), self._fingerprint(conf),
+               arg_signature(tok, pos, keys, temps, rem,
+                             *jax.tree_util.tree_leaves(state)),
+               self._decode_tag()) + self._policy_suffix()
+        fn = self._get(
+            key,
+            self._tp_build(lambda: _decode_multi_program(conf, policy, k)),
+            (sp, state, tok, pos, keys, temps, rem),
+            donate=self._decode_donate(),
+            shardings=self._decode_shardings(sp, state, 5))
+        if compile_only:
+            return None
+        with self._lock:
+            self.stats.steps += 1
+        return fn(*self._decode_place(sp, state, tok, pos, keys, temps,
+                                      rem))
+
+    def decode_multi_paged(self, conf, params, state, tok, pos, keys,
+                           temps, rem, page_table, k: int,
+                           compile_only: bool = False):
+        """`decode_multi` over the paged state ("decode-multi-paged[k]"
+        key entry): the page_table rides the whole block, so the host
+        must have allocated pages for all `k` positions up front."""
+        policy, sp = self._policy, self._serve_params(params)
+        key = ("decode-multi-paged[%d]" % int(k), self._fingerprint(conf),
+               arg_signature(tok, pos, keys, temps, rem, page_table,
+                             *jax.tree_util.tree_leaves(state)),
+               self._decode_tag()) + self._policy_suffix()
+        fn = self._get(
+            key,
+            self._tp_build(
+                lambda: _decode_multi_paged_program(conf, policy, k)),
+            (sp, state, tok, pos, keys, temps, rem, page_table),
+            donate=self._decode_donate(),
+            shardings=self._decode_shardings(sp, state, 6))
+        if compile_only:
+            return None
+        with self._lock:
+            self.stats.steps += 1
+        return fn(*self._decode_place(sp, state, tok, pos, keys, temps,
+                                      rem, page_table))
+
     def verify(self, conf, params, state, toks, pos, keys, temps,
                compile_only: bool = False):
         """Speculative verification step: toks [B, K] int32 (column 0 is
@@ -781,6 +836,43 @@ def _decode_program(conf, policy: str = "f32") -> Callable:
             logp = logp.astype(jnp.float32)
         tok2, keys2 = _sample_tokens(logp, keys, temps)
         return tok2, keys2, state
+
+    return program
+
+
+def _decode_multi_program(conf, policy: str = "f32", k: int = 1) -> Callable:
+    from deeplearning4j_tpu.nn import decode as decode_mod
+
+    pconf = _policy_conf(conf, policy)
+
+    def sample(logp, keys, temps):
+        if policy != "f32":
+            logp = logp.astype(jnp.float32)
+        return _sample_tokens(logp, keys, temps)
+
+    def program(params, state, tok, pos, keys, temps, rem):
+        return decode_mod.decode_block(
+            pconf, _policy_args(params, policy), state, tok, pos, keys,
+            temps, rem, k, sample)
+
+    return program
+
+
+def _decode_multi_paged_program(conf, policy: str = "f32",
+                                k: int = 1) -> Callable:
+    from deeplearning4j_tpu.nn import decode as decode_mod
+
+    pconf = _policy_conf(conf, policy)
+
+    def sample(logp, keys, temps):
+        if policy != "f32":
+            logp = logp.astype(jnp.float32)
+        return _sample_tokens(logp, keys, temps)
+
+    def program(params, state, tok, pos, keys, temps, rem, page_table):
+        return decode_mod.decode_block(
+            pconf, _policy_args(params, policy), state, tok, pos, keys,
+            temps, rem, k, sample, page_table=page_table)
 
     return program
 

@@ -41,6 +41,7 @@ Resilience (ISSUE 5):
 from __future__ import annotations
 
 import hashlib
+import heapq
 import io
 import queue
 import threading
@@ -78,6 +79,10 @@ LATENCY_BUCKETS_S = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
 #: histogram bucket bounds for tokens accepted per speculative verify
 #: step (`le` upper bounds; a round always accepts >= 1)
 ACCEPTED_TOKENS_BOUNDS = (1, 2, 3, 4, 6, 8, 12, 16)
+
+#: histogram bucket bounds for decode-block size K (tokens per host
+#: dispatch) — the `decode.steps_per_dispatch` tunable's search space
+DECODE_BLOCK_STEPS_BOUNDS = (1, 2, 4, 8, 16)
 
 #: in-memory prefix-cache entries kept per batcher (LRU; the disk store,
 #: when attached, holds evicted entries too)
@@ -159,7 +164,7 @@ class _Pending:
     """One enqueued request: its rows, completion event, and timing."""
 
     __slots__ = ("x", "rows", "done", "result", "error", "t_enqueue",
-                 "deadline", "priority")
+                 "deadline", "priority", "claimed")
 
     def __init__(self, x, deadline_ms: Optional[float] = None,
                  priority: str = "interactive"):
@@ -172,6 +177,10 @@ class _Pending:
         self.deadline = (None if deadline_ms is None
                          else self.t_enqueue + float(deadline_ms) / 1000.0)
         self.priority = priority
+        # lazy-deletion marker for the dispatcher's heaps: set when the
+        # request leaves its queue (dispatched or evicted), so stale
+        # heap entries are skipped instead of searched for
+        self.claimed = False
 
 
 class MicroBatcher:
@@ -211,6 +220,16 @@ class MicroBatcher:
         # key = (feature shape beyond axis 0, dtype): only requests that
         # concatenate into one well-formed batch share a queue
         self._queues: Dict[Tuple, Deque[_Pending]] = {}
+        # min-heaps with lazy deletion (ISSUE 19): every enqueue pushes
+        # (t_enqueue, seq, key, req) and, when a deadline exists,
+        # (deadline, t_enqueue, seq, key, req).  Requests leaving a
+        # queue flip `claimed` and are skipped when they surface at a
+        # heap top, so oldest-request / earliest-deadline queries are
+        # O(log n) instead of the linear scans they replaced.  `seq`
+        # breaks timestamp ties so requests are never compared.
+        self._arrival_heap: List[Tuple] = []
+        self._deadline_heap: List[Tuple] = []
+        self._seq = 0
         self._pending = 0
         self._stop = False
         self._thread: Optional[threading.Thread] = None
@@ -309,6 +328,13 @@ class MicroBatcher:
                 while i < len(q) and q[i].priority != "batch":
                     i += 1
                 q.insert(i, req)
+            self._seq += 1
+            heapq.heappush(self._arrival_heap,
+                           (req.t_enqueue, self._seq, key, req))
+            if req.deadline is not None:
+                heapq.heappush(
+                    self._deadline_heap,
+                    (req.deadline, req.t_enqueue, self._seq, key, req))
             self._pending += 1
             self._pending_by[priority] += 1
             self._cv.notify_all()
@@ -342,44 +368,43 @@ class MicroBatcher:
 
     def _oldest_key(self):
         """The queue holding the longest-waiting request (FIFO across
-        shapes: no shape can be starved by a busier one).  The oldest
-        request need not be the head — interactive preemption reorders
-        within a queue — so the deadline scan covers every entry."""
-        best_key, best_t = None, None
-        for key, q in self._queues.items():
-            if q:
-                t = min(r.t_enqueue for r in q)
-                if best_t is None or t < best_t:
-                    best_key, best_t = key, t
-        return best_key
+        shapes: no shape can be starved by a busier one).  The arrival
+        heap's first live entry IS the global oldest — claimed entries
+        pop off lazily, so the former every-entry scan is now
+        O(log n) amortized.  Caller holds `_cv`."""
+        h = self._arrival_heap
+        while h and h[0][3].claimed:
+            heapq.heappop(h)
+        return h[0][2] if h else None
 
     def _evict_expired_locked(self, now: float) -> None:
         """Answer every queued request whose deadline has passed with
         `DeadlineExceeded` — before it is coalesced, padded, or allowed
-        to hold a batch open.  Caller holds `_cv`."""
-        for q in self._queues.values():
-            expired = [r for r in q
-                       if r.deadline is not None and now >= r.deadline]
-            for r in expired:
-                q.remove(r)
-                self._pending -= 1
-                self._pending_by[r.priority] -= 1
-                self._reqs_by[r.priority] += 1
-                self._deadline_misses += 1
-                self._errors += 1
-                r.error = DeadlineExceeded(
-                    f"deadline exceeded after "
-                    f"{(now - r.t_enqueue) * 1e3:.1f}ms in queue")
-                r.done.set()
+        to hold a batch open.  Eviction order is the deadline heap's:
+        (deadline, t_enqueue) — earliest deadline first, FIFO within a
+        tie.  Caller holds `_cv`."""
+        h = self._deadline_heap
+        while h and (h[0][4].claimed or h[0][0] <= now):
+            _, _, _, key, r = heapq.heappop(h)
+            if r.claimed:
+                continue
+            r.claimed = True
+            self._queues[key].remove(r)
+            self._pending -= 1
+            self._pending_by[r.priority] -= 1
+            self._reqs_by[r.priority] += 1
+            self._deadline_misses += 1
+            self._errors += 1
+            r.error = DeadlineExceeded(
+                f"deadline exceeded after "
+                f"{(now - r.t_enqueue) * 1e3:.1f}ms in queue")
+            r.done.set()
 
     def _earliest_deadline_locked(self) -> Optional[float]:
-        best = None
-        for q in self._queues.values():
-            for r in q:
-                if r.deadline is not None and (best is None
-                                               or r.deadline < best):
-                    best = r.deadline
-        return best
+        h = self._deadline_heap
+        while h and h[0][4].claimed:
+            heapq.heappop(h)
+        return h[0][0] if h else None
 
     def _dispatch_loop(self) -> None:
         while True:
@@ -395,7 +420,9 @@ class MicroBatcher:
                 q = self._queues[key]
                 target = self._target_rows()
                 queued_rows = sum(r.rows for r in q)
-                flush_at = (min(r.t_enqueue for r in q) + self.max_delay_s)
+                # `_oldest_key` just cleaned the arrival heap's top, so
+                # its timestamp is the oldest live request's — no scan
+                flush_at = self._arrival_heap[0][0] + self.max_delay_s
                 # stopping: drain immediately rather than wait out SLOs
                 if (queued_rows < target and now < flush_at
                         and not self._stop):
@@ -415,6 +442,7 @@ class MicroBatcher:
                     rows += batch[-1].rows
                 self._pending -= len(batch)
                 for r in batch:
+                    r.claimed = True
                     self._pending_by[r.priority] -= 1
             self._execute(batch)
 
@@ -714,7 +742,8 @@ class ContinuousBatcher:
                  auto_start: bool = True, page_size: Optional[int] = None,
                  n_pages: int = 0, prefix_cache: bool = False,
                  prefix_match: str = "exact", draft_net=None,
-                 spec_k: int = 0):
+                 spec_k: int = 0,
+                 steps_per_dispatch: Optional[int] = None):
         from deeplearning4j_tpu.nn import decode as decode_mod
         from deeplearning4j_tpu.nn.conf import LayerType
 
@@ -798,6 +827,25 @@ class ContinuousBatcher:
                     f"max_seq={self.max_seq} exceeds the DRAFT model's "
                     f"positional table (max_seq_len={dbound})")
         self._draft_state = None  # device tree, B = n_slots (spec only)
+        # -- fused multi-step decode (ISSUE 19) ----------------------------
+        explicit_k = steps_per_dispatch is not None
+        if steps_per_dispatch is None:
+            steps_per_dispatch = tunables.resolve("decode.steps_per_dispatch")
+        k_max = int(steps_per_dispatch)
+        if k_max < 1:
+            raise ValueError(
+                f"steps_per_dispatch must be >= 1, got {k_max}")
+        if self.spec_k and k_max > 1:
+            if explicit_k:
+                raise ValueError(
+                    "speculative decoding is pinned to "
+                    "steps_per_dispatch=1: draft/verify rounds already "
+                    "advance multiple positions per dispatch and roll "
+                    "draft carries back per round; drop spec_k or "
+                    "steps_per_dispatch")
+            k_max = 1  # a tuned table's K>1 silently yields to spec
+        self.k_max = k_max
+        self._k_ladder = tunables.decode_k_ladder(k_max)
         self._cv = threading.Condition()
         self._pending: Deque[GenerationStream] = deque()
         self._stop = False
@@ -815,6 +863,10 @@ class ContinuousBatcher:
         self._spec_rounds = 0
         self._accept_hist = {"counts": [0] * len(ACCEPTED_TOKENS_BOUNDS),
                              "inf": 0, "sum": 0.0, "count": 0}
+        # adaptive-K ramp (decode-loop thread only): doubles per stable
+        # fused block up the warmed ladder, resets to 1 on any
+        # admission, release, or preemption
+        self._ramp = 1
         # -- stats (guarded by _cv's lock) ---------------------------------
         self._t_start = time.monotonic()
         self._tokens_total = 0
@@ -827,6 +879,13 @@ class ContinuousBatcher:
         self._ttfts: Deque[float] = deque(maxlen=4096)
         self._ttft_hist = {"counts": [0] * len(LATENCY_BUCKETS_S),
                            "inf": 0, "sum": 0.0, "count": 0}
+        # host-overhead accounting per dispatched block (guarded by
+        # _cv's lock): wall = dispatch-to-readback span, host = wall
+        # minus the time spent blocked in device_get
+        self._host_s = 0.0
+        self._wall_s = 0.0
+        self._blk_hist = {"counts": [0] * len(DECODE_BLOCK_STEPS_BOUNDS),
+                            "inf": 0, "sum": 0.0, "count": 0}
 
     # -- lifecycle ----------------------------------------------------------
     def start(self) -> "ContinuousBatcher":
@@ -969,6 +1028,7 @@ class ContinuousBatcher:
             self._draft_admit(slot, stream.prompt[:m])
         self._slots[slot] = stream
         self._temps[slot] = stream.temperature
+        self._ramp = 1  # slot set changed: fused blocks re-ramp from K=1
         now = time.monotonic()
         delivered = False
         if tok0 is not None:
@@ -1182,6 +1242,7 @@ class ContinuousBatcher:
         self._slots[slot] = None
         self._temps[slot] = 0.0
         self._feed[slot] = []
+        self._ramp = 1  # slot set changed: fused blocks re-ramp from K=1
         if self.paged:
             # release the slot's pages and point its table rows at the
             # scratch page so later junk writes stay inert
@@ -1210,6 +1271,7 @@ class ContinuousBatcher:
         self._slots[slot] = None
         self._temps[slot] = 0.0
         self._feed[slot] = []
+        self._ramp = 1  # slot set changed: fused blocks re-ramp from K=1
         if self.paged:
             self._pool.free(self._page_table[slot])
             self._page_table[slot, :] = 0
@@ -1242,26 +1304,35 @@ class ContinuousBatcher:
                     self._failed += 1
                 stream._finish(e)
 
-    def _lazy_alloc(self, k: int) -> None:
+    def _lazy_alloc(self, k: int, pos=None, steps=None) -> None:
         """Ensure every active slot has physical pages for its next `k`
         positions, allocating from the pool as streams cross page
         boundaries.  Genuine exhaustion past the admission gate
         (overcommit pressure) preempts the ONE stream that could not
         grow — requeued for recompute, never failed; an armed
         `decode.page_alloc` fault ends that stream with the injected
-        error.  Either way the table keeps decoding."""
+        error.  Either way the table keeps decoding.
+
+        The pipelined block loop passes its own scheduled `pos` array
+        (device positions lag the host's scheduling arithmetic there)
+        and a per-slot `steps` array — slots scheduled 0 steps this
+        block (budget already exhausted, release pending readback) must
+        not allocate pages they will never write."""
         ps = self.page_size
         for slot, stream in enumerate(self._slots):
             if stream is None:
                 continue
-            pos = int(self._pos[slot])
-            need = [p for p in range(pos // ps, (pos + k - 1) // ps + 1)
+            kk = k if steps is None else int(steps[slot])
+            if kk <= 0:
+                continue
+            p0 = int(self._pos[slot] if pos is None else pos[slot])
+            need = [p for p in range(p0 // ps, (p0 + kk - 1) // ps + 1)
                     if p < self.pages_per_slot
                     and self._page_table[slot, p] == 0]
             if not need:
                 continue
             try:
-                got = self._pool.alloc(len(need), slot=slot, pos=pos)
+                got = self._pool.alloc(len(need), slot=slot, pos=p0)
             except PagesExhausted:
                 self._preempt_slot(slot, stream)
                 continue
@@ -1277,6 +1348,9 @@ class ContinuousBatcher:
         emit per-slot tokens and free finished slots.  When speculative
         decoding is on and every active slot has room for a spec_k
         chunk, the step is a draft+verify round instead."""
+        import jax
+
+        t0 = time.monotonic()
         for slot, stream in enumerate(self._slots):
             if stream is None:
                 continue
@@ -1318,8 +1392,11 @@ class ContinuousBatcher:
                 dn.conf, dn.params, self._draft_state, self._tok.copy(),
                 self._pos.copy(), np.zeros((self.n_slots, 2), np.uint32),
                 np.zeros((self.n_slots,), np.float32))
-        tok2 = np.asarray(tok2)
-        keys2 = np.asarray(keys2)
+        # ONE batched device->host transfer for the (tokens, keys) pair
+        # instead of two blocking np.asarray round-trips (ISSUE 19)
+        t_get = time.monotonic()
+        tok2, keys2 = jax.device_get((tok2, keys2))
+        wait = time.monotonic() - t_get
         now = time.monotonic()
         emitted = 0
         for slot, stream in enumerate(self._slots):
@@ -1345,12 +1422,32 @@ class ContinuousBatcher:
             if (stream.tokens_emitted >= stream.max_new
                     or int(self._pos[slot]) >= self.max_seq):
                 self._release_slot(slot, stream)
+        self._note_block(1, time.monotonic() - t0, wait, emitted, now)
+
+    def _note_block(self, k: int, wall: float, wait: float, emitted: int,
+                    now: float) -> None:
+        """Per-dispatch bookkeeping shared by the K=1 step and the fused
+        block loop: token totals + trailing rate window, the block-size
+        histogram, and the host-overhead split (host = wall minus the
+        time spent blocked in device_get)."""
+        host = max(wall - wait, 0.0)
         with self._cv:
             self._tokens_total += emitted
             self._recent_tokens.append((now, emitted))
             while (self._recent_tokens
                    and now - self._recent_tokens[0][0] > RATE_WINDOW_S):
                 self._recent_tokens.popleft()
+            self._host_s += host
+            self._wall_s += wall
+            h = self._blk_hist
+            h["sum"] += k
+            h["count"] += 1
+            for i, bound in enumerate(DECODE_BLOCK_STEPS_BOUNDS):
+                if k <= bound:
+                    h["counts"][i] += 1
+                    break
+            else:
+                h["inf"] += 1
 
     def _spec_once(self) -> None:
         """One speculative round: the draft proposes spec_k - 1 tokens
@@ -1459,11 +1556,161 @@ class ContinuousBatcher:
                 else:
                     h["inf"] += 1
 
+    # -- fused multi-step decode (ISSUE 19) ----------------------------------
+    def _has_pending(self) -> bool:
+        with self._cv:
+            return bool(self._pending)
+
+    def _block_eligible(self) -> bool:
+        """Fused blocks run only while the slot set is stable: K pins to
+        1 (the `_decode_once` path) whenever pending admissions exist,
+        prompt feeds are mid-flight, or speculative decoding owns the
+        step — TTFT, prompt-feed, and replay semantics stay exactly the
+        K=1 loop's."""
+        if self.k_max <= 1 or self.spec_k:
+            return False
+        if any(self._feed):
+            return False
+        return not self._has_pending()
+
+    def _next_k(self, max_rem: int) -> int:
+        """Largest warmed-ladder K within the ramp and the longest
+        remaining per-slot budget.  The ramp doubles per stable
+        dispatched block (1 -> 2 -> ... -> k_max) and resets to 1 on
+        any admission, release, or preemption."""
+        k = 1
+        for v in self._k_ladder:
+            if v <= self._ramp and v <= max_rem:
+                k = v
+        return k
+
+    def _block_rounds(self) -> None:
+        """Pipelined fused-block decode: dispatch block N+1 BEFORE
+        reading back block N, so the host's per-block work (delivery,
+        bookkeeping) overlaps the device's compute, and fetch each
+        block's whole [K, slots] token array in ONE device->host
+        transfer.  Per-slot progress is tracked with deterministic
+        scheduling arithmetic — block N+1's token/key arguments are
+        block N's DEVICE outputs, chained without a sync — so no
+        readback is needed to keep dispatching.  The loop returns to
+        the outer admission path the moment pending streams exist
+        (bounded by the one in-flight block)."""
+        import jax
+
+        ic = self.net.infer_cache
+        nb = self.n_slots
+        streams = list(self._slots)
+        pos = self._pos.copy()
+        rem = np.zeros((nb,), np.int32)
+        for s, stream in enumerate(streams):
+            if stream is not None:
+                budget = (stream.max_new - stream.tokens_emitted
+                          + stream._replay)
+                rem[s] = max(0, min(budget, self.max_seq - int(pos[s])))
+        tok, keys = self._tok.copy(), self._keys.copy()
+        inflight = None
+        t_mark = time.monotonic()
+        while True:
+            blk = None
+            if int(rem.max(initial=0)) > 0 and not self._has_pending():
+                blk = self._dispatch_block(ic, streams, tok, keys, pos, rem)
+                if blk is not None:
+                    tok, keys = blk["tok"], blk["keys"]
+            if inflight is not None:
+                t_mark = self._readback_block(inflight, t_mark)
+            inflight = blk
+            if blk is None:
+                return
+
+    def _dispatch_block(self, ic, streams, tok, keys, pos, rem):
+        """Dispatch ONE fused K-step block (no sync): fire the per-slot
+        fault points for every scheduled position (a raise ends THAT
+        stream only, before its rows are dispatched), allocate pages for
+        the whole block, then launch the decode-multi program.  Updates
+        the caller's scheduled pos/rem in place; returns the in-flight
+        block record, or None when nothing remained to dispatch."""
+        k = self._next_k(int(rem.max(initial=0)))
+        for s, stream in enumerate(streams):
+            if (stream is None or rem[s] <= 0
+                    or self._slots[s] is not stream):
+                continue
+            try:
+                for j in range(min(k, int(rem[s]))):
+                    faults.fire("decode.step", slot=s, pos=int(pos[s]) + j,
+                                block=k)
+            except BaseException as e:  # noqa: BLE001 — isolate the stream
+                self._release_slot(s, stream, error=e)
+                rem[s] = 0
+        if int(rem.max(initial=0)) <= 0:
+            return None
+        if self.paged:
+            self._lazy_alloc(k, pos=pos, steps=np.minimum(rem, k))
+            for s, stream in enumerate(streams):
+                if stream is not None and self._slots[s] is not stream:
+                    rem[s] = 0  # preempted/failed during page growth
+            if int(rem.max(initial=0)) <= 0:
+                return None
+            toks, tok2, keys2, self._state = ic.decode_multi_paged(
+                self.net.conf, self.net.params, self._state, tok,
+                pos.copy(), keys, self._temps.copy(), rem.copy(),
+                self._page_table.copy(), k)
+        else:
+            toks, tok2, keys2, self._state = ic.decode_multi(
+                self.net.conf, self.net.params, self._state, tok,
+                pos.copy(), keys, self._temps.copy(), rem.copy(), k)
+        adv = np.minimum(rem, k).astype(np.int32)
+        pos += adv
+        rem -= adv
+        self._ramp = min(self._ramp * 2, self.k_max)
+        return {"k": k, "streams": streams, "toks": toks, "tok": tok2,
+                "keys": keys2, "adv": adv, "pos_after": pos.copy()}
+
+    def _readback_block(self, blk, t_mark: float) -> float:
+        """Read back ONE in-flight block — a single device_get for the
+        ([K, slots] tokens, last token, keys) triple — then the host
+        side: per-stream delivery (replay-aware), TTFT, releases, and
+        host-overhead accounting.  Returns the new wall-clock mark."""
+        import jax
+
+        t_get = time.monotonic()
+        toks, tok_last, keys_last = jax.device_get(
+            (blk["toks"], blk["tok"], blk["keys"]))
+        wait = time.monotonic() - t_get
+        now = time.monotonic()
+        emitted = 0
+        for s, stream in enumerate(blk["streams"]):
+            if stream is None or int(blk["adv"][s]) <= 0:
+                continue
+            if self._slots[s] is not stream:
+                continue  # released or preempted since dispatch
+            first = stream.tokens_emitted == 0
+            sent_first = False
+            for j in range(int(blk["adv"][s])):
+                if stream._deliver(int(toks[j, s]), now):
+                    emitted += 1
+                    if first and not sent_first:
+                        sent_first = True
+            self._tok[s] = tok_last[s]
+            self._keys[s] = keys_last[s]
+            self._pos[s] = blk["pos_after"][s]
+            if sent_first:
+                with self._cv:
+                    self._record_ttft_locked(stream)
+            if (stream.tokens_emitted >= stream.max_new
+                    or int(self._pos[s]) >= self.max_seq):
+                self._release_slot(s, stream)
+        t_end = time.monotonic()
+        self._note_block(blk["k"], t_end - t_mark, wait, emitted, now)
+        return t_end
+
     def _decode_loop(self) -> None:
         while True:
             self._admit_pending()
             if any(s is not None for s in self._slots):
-                self._decode_once()
+                if self._block_eligible():
+                    self._block_rounds()
+                else:
+                    self._decode_once()
                 continue
             with self._cv:
                 if self._pending:
@@ -1483,6 +1730,7 @@ class ContinuousBatcher:
                          if now - t <= RATE_WINDOW_S)
             ttfts = sorted(self._ttfts)
             h = self._ttft_hist
+            bh = self._blk_hist
             active = self._active
             out = {
                 "slots": {"width": self.n_slots, "active": active,
@@ -1510,6 +1758,18 @@ class ContinuousBatcher:
                     "inf": h["inf"],
                     "sum": h["sum"],
                     "count": h["count"],
+                },
+                "steps_per_dispatch": self.k_max,
+                "host_overhead_fraction": (
+                    round(self._host_s / self._wall_s, 4)
+                    if self._wall_s > 0 else 0.0),
+                "decode_host_seconds_total": round(self._host_s, 6),
+                "decode_block_steps": {
+                    "bounds": list(DECODE_BLOCK_STEPS_BOUNDS),
+                    "counts": list(bh["counts"]),
+                    "inf": bh["inf"],
+                    "sum": bh["sum"],
+                    "count": bh["count"],
                 },
             }
         if self.paged:

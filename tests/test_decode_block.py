@@ -1,0 +1,270 @@
+"""Fused multi-step decode: K tokens per host dispatch (ISSUE 19).
+
+The correctness anchor: the K-step fused block (`lax.scan` over the
+decode step, sampling in-program, state donated) must reproduce the
+K=1 loop EXACTLY — the per-slot PRNG key splits exactly once per
+emitted token and a slot that exhausts its budget mid-block freezes
+(its KV rows stop mutating, the block emits the sentinel) — so the
+token trajectory is bitwise-identical for ANY K, greedy and seeded
+temperature, dense and paged, on both generative zoo models.  Around
+that anchor: the batcher's adaptive-K policy (pending admissions pin
+K to 1 so TTFT semantics never change), the speculative-decoding
+pin, the chaos contract inside a block, and warm-start coverage of
+the whole K ladder.
+
+Tier-1: CPU-only, tiny models."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from test_generate import _compiled_tokens, lstm_net, transformer_net  # noqa: F401
+from deeplearning4j_tpu.models.zoo import char_lstm
+from deeplearning4j_tpu.nn import decode as decode_mod
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.optimize import tunables
+from deeplearning4j_tpu.reliability import faults
+from deeplearning4j_tpu.serving.batcher import ContinuousBatcher
+
+VOCAB = 13
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.reset()
+    yield
+    faults.reset()
+
+
+# -- token parity: any K, any sampler, dense and paged ------------------------
+
+@pytest.mark.parametrize("model", ["lstm", "transformer"])
+@pytest.mark.parametrize("temperature", [0.0, 0.8])
+@pytest.mark.parametrize("k", [1, 4, 8])
+@pytest.mark.parametrize("paged", [False, True])
+def test_fused_block_token_parity(request, model, temperature, k, paged):
+    """steps_per_dispatch is a THROUGHPUT knob, never a sampling
+    change: for every K the batcher's trajectory equals the K=1
+    compiled loop token-for-token, greedy and seeded temperature,
+    dense and paged."""
+    net = request.getfixturevalue(f"{model}_net")
+    prompts = ([1, 2, 3], [4, 5])
+    refs = [_compiled_tokens(net, list(p), 10, temperature=temperature,
+                             rng_seed=i)
+            for i, p in enumerate(prompts)]
+    cb = ContinuousBatcher(net, n_slots=2, max_seq=16,
+                           prompt_buckets=(8,),
+                           page_size=4 if paged else 0,
+                           steps_per_dispatch=k)
+    try:
+        streams = [cb.submit(list(p), max_new_tokens=10,
+                             temperature=temperature, rng_seed=i)
+                   for i, p in enumerate(prompts)]
+        got = [list(s.tokens(timeout=60.0)) for s in streams]
+        assert got == refs
+    finally:
+        cb.stop()
+
+
+def test_fused_block_reaches_kmax_and_reports_overhead(lstm_net):
+    """A slot-stable table ramps to K_max (the block-size histogram
+    shows a K=8 bucket) and the stats block reports the host-overhead
+    fraction the fused dispatch exists to amortise."""
+    cb = ContinuousBatcher(lstm_net, n_slots=2, max_seq=32,
+                           prompt_buckets=(8,), steps_per_dispatch=8)
+    try:
+        streams = [cb.submit([1, 2], max_new_tokens=28, rng_seed=i)
+                   for i in range(2)]
+        for s in streams:
+            assert len(list(s.tokens(timeout=60.0))) == 28
+        st = cb.stats()
+        assert st["steps_per_dispatch"] == 8
+        h = st["decode_block_steps"]
+        assert h["count"] > 0
+        # bounds (1, 2, 4, 8, 16): the ramp reached the K=8 bucket
+        assert h["counts"][3] > 0
+        assert 0.0 <= st["host_overhead_fraction"] <= 1.0
+        assert st["decode_host_seconds_total"] > 0.0
+    finally:
+        cb.stop()
+
+
+# -- mid-block freeze ---------------------------------------------------------
+
+def test_decode_block_freezes_exhausted_rows(lstm_net):
+    """Program-level: a row whose remaining budget runs out mid-block
+    emits the sentinel for the frozen steps, its token/key carry stops
+    advancing, and the emitted prefix equals the K=1 trajectory."""
+    conf, params = lstm_net.conf, lstm_net.params
+    ic = lstm_net.infer_cache
+    refs = [_compiled_tokens(lstm_net, [1, 2, 3], 9, rng_seed=0),
+            _compiled_tokens(lstm_net, [4, 5], 9, rng_seed=1)]
+    state = ic.init_decode_state(conf, 2, 16)
+    pb = np.zeros((2, 8), np.int32)
+    pb[0, :3] = [1, 2, 3]
+    pb[1, :2] = [4, 5]
+    length = jnp.asarray([3, 2], jnp.int32)
+    keys = jnp.asarray(np.stack([np.asarray(jax.random.PRNGKey(0)),
+                                 np.asarray(jax.random.PRNGKey(1))]))
+    temps = jnp.zeros((2,), jnp.float32)
+    tok, keys, state = ic.prefill(conf, params, state, jnp.asarray(pb),
+                                  length, keys, temps)
+    pos = jnp.asarray([3, 2], jnp.int32)
+    # slot 0 has 3 steps of budget left, slot 1 has 8: one K=8 block
+    rem = jnp.asarray([3, 8], jnp.int32)
+    toks, tok, keys, state = ic.decode_multi(conf, params, state, tok,
+                                             pos, keys, temps, rem, 8)
+    toks = np.asarray(jax.device_get(toks))
+    # emitted prefixes match the K=1 loop (prefill already emitted
+    # refs[s][0]); the frozen tail is all sentinel
+    assert list(toks[:3, 0]) == refs[0][1:4]
+    assert list(toks[3:, 0]) == [decode_mod.BLOCK_SENTINEL] * 5
+    assert list(toks[:, 1]) == refs[1][1:9]
+    # the frozen row's carry stopped: its last token is the 3rd one
+    assert int(jax.device_get(tok)[0]) == refs[0][3]
+
+
+def test_batcher_mid_block_freeze_parity(lstm_net):
+    """Batcher-level: two streams with different budgets inside one
+    K=8 block both land exactly their K=1 trajectories — the short
+    stream stops, the long one decodes on."""
+    refs = [_compiled_tokens(lstm_net, [1, 2], 3, rng_seed=0),
+            _compiled_tokens(lstm_net, [3, 4], 12, rng_seed=1)]
+    cb = ContinuousBatcher(lstm_net, n_slots=2, max_seq=16,
+                           prompt_buckets=(8,), steps_per_dispatch=8)
+    try:
+        a = cb.submit([1, 2], max_new_tokens=3, rng_seed=0)
+        b = cb.submit([3, 4], max_new_tokens=12, rng_seed=1)
+        assert list(a.tokens(timeout=60.0)) == refs[0]
+        assert list(b.tokens(timeout=60.0)) == refs[1]
+    finally:
+        cb.stop()
+
+
+# -- adaptive K ---------------------------------------------------------------
+
+def test_pending_admissions_pin_k_to_one(lstm_net):
+    """Fused blocks never run while admissions wait: TTFT semantics
+    are the K=1 loop's.  (Unit check on the eligibility gate — the
+    decode thread is not running.)"""
+    cb = ContinuousBatcher(lstm_net, n_slots=2, max_seq=16,
+                           prompt_buckets=(8,), steps_per_dispatch=8,
+                           auto_start=False)
+    try:
+        assert cb._block_eligible()          # idle, no queue
+        cb.submit([1, 2], max_new_tokens=4)
+        assert not cb._block_eligible()      # pending admission -> K=1
+    finally:
+        cb.stop()
+
+
+def test_admissions_mid_run_reset_the_ramp(lstm_net):
+    """End-to-end: staggered arrivals force K=1 blocks (or the plain
+    step path) around every admission, yet every stream still lands
+    its exact K=1 trajectory."""
+    refs = [_compiled_tokens(lstm_net, [i + 1], 12, rng_seed=i)
+            for i in range(4)]
+    cb = ContinuousBatcher(lstm_net, n_slots=2, max_seq=16,
+                           prompt_buckets=(8,), steps_per_dispatch=8)
+    try:
+        streams = [cb.submit([i + 1], max_new_tokens=12, rng_seed=i)
+                   for i in range(4)]
+        got = [list(s.tokens(timeout=60.0)) for s in streams]
+        assert got == refs
+        h = cb.stats()["decode_block_steps"]
+        # the ramp restarted from K=1 after the mid-run admissions
+        assert h["counts"][0] > 0
+    finally:
+        cb.stop()
+
+
+def test_explicit_k_with_speculation_is_an_error(lstm_net):
+    draft = MultiLayerNetwork(char_lstm(VOCAB, hidden=8, n_layers=1),
+                              seed=1).init()
+    with pytest.raises(ValueError, match="steps_per_dispatch=1"):
+        ContinuousBatcher(lstm_net, n_slots=2, max_seq=16,
+                          prompt_buckets=(8,), draft_net=draft,
+                          spec_k=3, steps_per_dispatch=4)
+
+
+def test_tuned_k_with_speculation_silently_pins_to_one(lstm_net):
+    """A tuned-table K must not break a speculative server: the
+    batcher silently pins to 1 (speculation already advances multiple
+    tokens per dispatch) instead of erroring on a fleet-shared
+    table."""
+    draft = MultiLayerNetwork(char_lstm(VOCAB, hidden=8, n_layers=1),
+                              seed=1).init()
+    tunables.install(tunables.TunedTable(
+        {"decode.steps_per_dispatch": 8}, device_kind="test"))
+    try:
+        cb = ContinuousBatcher(lstm_net, n_slots=2, max_seq=16,
+                               prompt_buckets=(8,), draft_net=draft,
+                               spec_k=3)
+        try:
+            assert cb.k_max == 1
+            ref = _compiled_tokens(lstm_net, [1, 2], 5)
+            assert cb.generate([1, 2], max_new_tokens=5) == ref
+        finally:
+            cb.stop()
+    finally:
+        tunables.clear()
+
+
+# -- chaos: a fault inside a block fails only its stream ----------------------
+
+def test_block_fault_fails_one_stream_others_decode_on(lstm_net):
+    """decode.step fires per slot per SCHEDULED position inside a
+    block, so an nth-armed fault lands mid-ramp: the doomed stream
+    ends with the injected error BEFORE its rows dispatch, its
+    neighbour finishes the very same block with its exact K=1
+    trajectory."""
+    ref_b = _compiled_tokens(lstm_net, [3, 4], 20, rng_seed=1)
+    # traversal order with two admitted slots: block1 (K=1) fires
+    # slot0, slot1; block2 (K=2) fires slot0 twice -> nth=4 lands on
+    # slot 0's second scheduled position INSIDE the K=2 block
+    faults.arm("decode.step", "raise", nth=4)
+    cb = ContinuousBatcher(lstm_net, n_slots=2, max_seq=32,
+                           prompt_buckets=(8,), steps_per_dispatch=8,
+                           auto_start=False)
+    try:
+        a = cb.submit([1, 2], max_new_tokens=20, rng_seed=0)
+        b = cb.submit([3, 4], max_new_tokens=20, rng_seed=1)
+        cb.start()
+        assert list(b.tokens(timeout=60.0)) == ref_b
+        with pytest.raises(faults.FaultInjected):
+            list(a.tokens(timeout=60.0))
+        st = cb.stats()
+        assert st["streams"]["failed"] == 1
+        assert st["streams"]["completed"] == 1
+        # the failed slot was released: a new stream admits and finishes
+        faults.disarm()
+        assert len(cb.generate([5], max_new_tokens=3)) == 3
+    finally:
+        cb.stop()
+
+
+# -- warm start: the whole K ladder compiles up front -------------------------
+
+def test_warmup_covers_the_k_ladder():
+    """A warmed batcher serves its first fused-decode streams with
+    ZERO fresh compiles at the tuned K — every ladder value (K=1
+    included: ramp resets dispatch the fused block at 1) was compiled
+    by warmup_generate."""
+    net = MultiLayerNetwork(char_lstm(VOCAB, hidden=16, n_layers=2),
+                            seed=0).init()
+    net.warmup_generate(slots=2, max_seq=16, prompt_buckets=(8,),
+                        steps_per_dispatch=4)
+    warmed = net.infer_cache.stats.misses
+    cb = ContinuousBatcher(net, n_slots=2, max_seq=16,
+                           prompt_buckets=(8,), steps_per_dispatch=4)
+    try:
+        streams = [cb.submit([i + 1, i + 2], max_new_tokens=12, rng_seed=i)
+                   for i in range(2)]
+        for s in streams:
+            assert len(list(s.tokens(timeout=60.0))) == 12
+        assert net.infer_cache.stats.misses == warmed
+        assert cb.stats()["decode_block_steps"]["count"] > 0
+    finally:
+        cb.stop()

@@ -366,3 +366,60 @@ def test_closed_loop_load_batches_and_stays_bitwise():
     # than requests
     flushes = sum(st["batch_rows_hist"].values())
     assert flushes < st["requests"], st
+
+
+# -- deadline-heap eviction order (ISSUE 19 satellite) ------------------------
+
+def test_eviction_order_is_deadline_then_fifo():
+    """The dispatcher's deadline heap pins eviction order to
+    (deadline, t_enqueue): earliest deadline first, FIFO within a tie —
+    independent of arrival order.  Driven directly against the enqueue
+    plumbing so deadlines and enqueue times are exact, not wall-clock."""
+    import heapq
+    from collections import deque
+
+    from deeplearning4j_tpu.serving.batcher import _Pending
+
+    batcher = MicroBatcher(_net(), auto_start=False)
+    evicted = []
+
+    class _Recorder:
+        def __init__(self, name):
+            self.name = name
+
+        def set(self):
+            evicted.append(self.name)
+
+    def enqueue(name, t_enqueue, deadline):
+        req = _Pending(_x(1, seed=0))
+        req.t_enqueue = t_enqueue
+        req.deadline = deadline
+        req.done = _Recorder(name)
+        key = (req.x.shape[1:], str(req.x.dtype))
+        with batcher._cv:
+            batcher._queues.setdefault(key, deque()).append(req)
+            batcher._seq += 1
+            heapq.heappush(batcher._arrival_heap,
+                           (req.t_enqueue, batcher._seq, key, req))
+            heapq.heappush(batcher._deadline_heap,
+                           (req.deadline, req.t_enqueue, batcher._seq,
+                            key, req))
+            batcher._pending += 1
+            batcher._pending_by[req.priority] += 1
+        return req
+
+    # arrival order a, b, c, d — NOT the eviction order
+    enqueue("a", t_enqueue=1.0, deadline=30.0)   # latest deadline
+    enqueue("b", t_enqueue=2.0, deadline=10.0)   # deadline tie with c,
+    enqueue("c", t_enqueue=3.0, deadline=10.0)   # broken by t_enqueue
+    enqueue("d", t_enqueue=4.0, deadline=5.0)       # earliest deadline
+    with batcher._cv:
+        batcher._evict_expired_locked(now=20.0)  # a's deadline unexpired
+    assert evicted == ["d", "b", "c"]
+    assert batcher.queue_depth() == 1
+    assert batcher.stats()["deadline_misses"] == 3
+    # the survivor is still dispatchable: its heap entries are live
+    with batcher._cv:
+        assert batcher._earliest_deadline_locked() == 30.0
+        assert batcher._oldest_key() is not None
+    batcher.stop()
