@@ -1320,15 +1320,22 @@ def bench_fleet_slo(devs) -> None:
                     np.float32).tolist()}).encode()
             for rows in sorted(set(row_mix))}
 
-        def open_loop(url, rate_rps, duration_s, seed=0):
+        def open_loop(url, rate_rps, duration_s, seed=0, ramp=1.0,
+                      detail=None):
             """Poisson arrivals at `rate_rps` for `duration_s`; every
             arrival fires regardless of how the fleet is doing (that is
-            the open-loop point).  Returns (rows/s completed, p99 ms,
-            errors, offered requests)."""
+            the open-loop point).  When `ramp` > 1 the arrival rate
+            climbs linearly to ramp*rate_rps over the run (the diurnal
+            arm), and a `detail` dict gets per-segment timelines so the
+            caller can find the highest offered rate the fleet sustained
+            inside the SLO.  Returns (rows/s completed, p99 ms, errors,
+            offered requests)."""
             arr_rng = random_mod.Random(seed)
             lock = threading.Lock()
             lat, rows_done, errors, offered = [], [0], [0], [0]
+            done = []  # (t_done_rel_s, latency_s, nrows)
             threads = []
+            t_begin = time.perf_counter()
 
             def one(body, nrows):
                 t0 = time.perf_counter()
@@ -1342,11 +1349,12 @@ def bench_fleet_slo(devs) -> None:
                     with lock:
                         lat.append(dt)
                         rows_done[0] += nrows
+                        done.append((time.perf_counter() - t_begin,
+                                     dt, nrows))
                 except Exception:
                     with lock:
                         errors[0] += 1
 
-            t_begin = time.perf_counter()
             t_next = t_begin
             deadline = t_begin + duration_s
             while t_next < deadline:
@@ -1359,10 +1367,37 @@ def bench_fleet_slo(devs) -> None:
                 t.start()
                 threads.append(t)
                 offered[0] += 1
-                t_next += arr_rng.expovariate(rate_rps)
+                frac = min(max((t_next - t_begin) / duration_s, 0.0), 1.0)
+                t_next += arr_rng.expovariate(
+                    rate_rps * (1.0 + (ramp - 1.0) * frac))
             for t in threads:
                 t.join(timeout=35.0)
             dt = time.perf_counter() - t_begin
+            if detail is not None:
+                n_seg = 4
+                seg_len = duration_s / n_seg
+                segs = []
+                for i in range(n_seg):
+                    lo = i * seg_len
+                    hi = (i + 1) * seg_len if i < n_seg - 1 else float("inf")
+                    ds = [(d, r) for t_d, d, r in done if lo <= t_d < hi]
+                    vals = sorted(d for d, _ in ds)
+                    p99 = (vals[min(len(vals) - 1,
+                                    int(0.99 * (len(vals) - 1)))] * 1e3
+                           if vals else None)
+                    segs.append({
+                        "t_s": [round(lo, 2),
+                                round(min((i + 1) * seg_len, duration_s),
+                                      2)],
+                        "offered_rps": round(
+                            rate_rps * (1.0 + (ramp - 1.0)
+                                        * (i + 0.5) / n_seg), 1),
+                        "rows_per_sec": round(
+                            sum(r for _, r in ds) / seg_len, 1),
+                        "p99_ms": (round(p99, 2) if p99 is not None
+                                   else None),
+                    })
+                detail["segments"] = segs
 
             def pct(q):
                 vals = sorted(lat)
@@ -1469,6 +1504,109 @@ def bench_fleet_slo(devs) -> None:
                                 "warm-cache respawn)")
         finally:
             stop_fleet(proc)
+
+        # -- arm 3: diurnal ramp, 1 host vs 2 simulated agent hosts ---------
+        # the arrival rate doubles over the run (the diurnal morning).
+        # Both fleets start at 1 replica with the autoscaler allowed to
+        # grow to 2; the 2-host arm places replicas through two local
+        # ReplicaAgent processes (simulated hosts), so a scale-up crosses
+        # the agent control plane and warms from the cachesync wire.
+        if SMALL:
+            ramp_s, ramp_rate = 8.0, 10.0
+        else:
+            ramp_s, ramp_rate = 20.0, 20.0
+
+        def start_agent():
+            p = subprocess.Popen(
+                [sys.executable, "-m", "deeplearning4j_tpu.cli", "agent",
+                 "--port", "0", "--compile-cache", cache,
+                 "--max-replicas", "2"],
+                stdout=subprocess.PIPE, text=True, env=env)
+            return p, json_mod.loads(p.stdout.readline())["url"]
+
+        def stop_agent(p):
+            p.send_signal(signal.SIGTERM)
+            try:
+                p.communicate(timeout=60)
+            except subprocess.TimeoutExpired:
+                p.kill()
+                p.communicate()
+
+        diurnal = {}
+        for label, n_agents in (("1_host", 0), ("2_agent_hosts", 2)):
+            agent_procs = []
+            extra = ["--min-replicas", "1", "--max-replicas", "2",
+                     "--slo-p99-ms", str(slo_p99_ms / 5.0)]
+            for _ in range(n_agents):
+                p, u = start_agent()
+                agent_procs.append(p)
+                extra += ["--agent", u]
+            proc, summary = start_fleet(1, extra=tuple(extra))
+            timeline = []
+            stop_poll = threading.Event()
+
+            def poll_timeline(url=summary["url"], timeline=timeline):
+                t0 = time.perf_counter()
+                last_n = None
+                while not stop_poll.wait(0.5):
+                    try:
+                        with urllib.request.urlopen(url + "/v1/stats",
+                                                    timeout=5) as r:
+                            st = json_mod.loads(r.read())
+                    except Exception:
+                        continue
+                    n = st.get("healthy_replicas", 0)
+                    if n != last_n:
+                        timeline.append({
+                            "t_s": round(time.perf_counter() - t0, 1),
+                            "healthy_replicas": n,
+                            "decisions": (st.get("autoscaler") or {})
+                                .get("decisions", {})})
+                        last_n = n
+            poller = threading.Thread(target=poll_timeline)
+            poller.start()
+            detail = {}
+            try:
+                rows_s, p99_ms, errors, offered = open_loop(
+                    summary["url"], ramp_rate, ramp_s, seed=11,
+                    ramp=2.0, detail=detail)
+            finally:
+                stop_poll.set()
+                poller.join()
+                stop_fleet(proc)
+                for p in agent_procs:
+                    stop_agent(p)
+            inside = [s for s in detail.get("segments", [])
+                      if s["p99_ms"] is not None
+                      and s["p99_ms"] <= slo_p99_ms]
+            best = max(inside, key=lambda s: s["rows_per_sec"],
+                       default=None)
+            diurnal[label] = {
+                "sustained_rows_per_sec": (best or {}).get("rows_per_sec",
+                                                           0.0),
+                "sustained_offered_rps": (best or {}).get("offered_rps"),
+                "overall_rows_per_sec": round(rows_s, 1),
+                "overall_p99_ms": round(p99_ms, 2),
+                "errors": errors,
+                "offered_requests": offered,
+                "zero_drop": errors == 0,
+                "segments": detail.get("segments", []),
+                "scale_events": timeline,
+            }
+        _emit("fleet diurnal-ramp sustained rows/sec (2 agent hosts)",
+              diurnal["2_agent_hosts"]["sustained_rows_per_sec"],
+              "rows/sec",
+              diurnal["2_agent_hosts"]["sustained_rows_per_sec"]
+              / max(diurnal["1_host"]["sustained_rows_per_sec"], 1e-9),
+              slo_p99_ms=slo_p99_ms, ramp="2x over the run",
+              open_loop="poisson", row_mix=list(row_mix),
+              diurnal_1_host=diurnal["1_host"],
+              diurnal_2_agent_hosts=diurnal["2_agent_hosts"],
+              baseline_note="vs_baseline = 2-agent-host / 1-host best "
+                            "ramp segment rows/s with p99 under the SLO; "
+                            "scale_events shows autoscaler decisions and "
+                            "healthy-replica transitions (zero_drop = no "
+                            "request errored across the whole ramp)")
     finally:
         shutil.rmtree(tmp, ignore_errors=True)
 

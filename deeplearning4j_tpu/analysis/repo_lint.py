@@ -246,6 +246,41 @@ def _rule_wall_clock(tree: ast.AST, relpath: str) -> List[Finding]:
     return out
 
 
+def _rule_unbounded_network_call(tree: ast.AST,
+                                 relpath: str) -> List[Finding]:
+    """error: a network call in serving/ without an explicit
+    `timeout=`.  The default urllib/socket timeout is 'forever'; one
+    partitioned peer then wedges the calling thread — and the serving
+    control plane (router polls, agent heartbeats, cache fetches) is
+    built from exactly these calls.  Every one must bound its wait."""
+    if not _in_scope(relpath, ("serving/",)):
+        return []
+    out = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        chain = _attr_chain(node.func)
+        if not chain:
+            continue
+        tail = chain.split(".")[-1]
+        has_timeout = any(kw.arg == "timeout" for kw in node.keywords)
+        if tail == "urlopen" and not has_timeout:
+            out.append(Finding(
+                "unbounded-network-call", "error", _loc(relpath, node),
+                "urlopen without an explicit timeout= in serving/ — a "
+                "partitioned peer wedges this thread forever; bound "
+                "every network wait"))
+        elif tail == "create_connection" and not has_timeout \
+                and len(node.args) < 2:
+            # socket.create_connection(addr[, timeout]): positional
+            # timeout counts too
+            out.append(Finding(
+                "unbounded-network-call", "error", _loc(relpath, node),
+                "socket connect without an explicit timeout in "
+                "serving/ — bound every network wait"))
+    return out
+
+
 def _rule_f64(tree: ast.AST, relpath: str) -> List[Finding]:
     if not _in_scope(relpath, DEVICE_PATH_SCOPES):
         return []
@@ -601,6 +636,7 @@ def lint_source(src: str, relpath: str = "<memory>",
     findings += _rule_platform_sniff(tree, relpath)
     findings += _rule_hardcoded_tunable(tree, relpath)
     findings += _rule_wall_clock(tree, relpath)
+    findings += _rule_unbounded_network_call(tree, relpath)
     findings += _rule_f64(tree, relpath)
     findings += _rule_fault_point(tree, relpath, documented)
     findings += _rule_prom_family(tree, relpath)

@@ -47,9 +47,17 @@ def _load_model(model_dir):
 
 def _attach_compile_cache(net, args) -> None:
     """--compile-cache DIR: persistent on-disk program store shared by
-    the train-step and serve-path caches (see optimize/persist.py)."""
+    the train-step and serve-path caches (see optimize/persist.py).
+    --cache-from URL (repeatable) adds a remote-then-compile fallback:
+    a locally-absent entry is fetched from a peer agent or cache server
+    over the cachesync wire before being compiled."""
     if getattr(args, "compile_cache", None):
-        net.set_compile_cache(args.compile_cache)
+        store = net.set_compile_cache(args.compile_cache)
+        sources = getattr(args, "cache_from", None)
+        if sources:
+            from deeplearning4j_tpu.serving.cachesync import CacheFetcher
+
+            store.set_remote(CacheFetcher(list(sources)))
 
 
 def _disk_stats(net) -> dict:
@@ -62,6 +70,8 @@ def _disk_stats(net) -> dict:
             cs.disk_write_seconds + ic.disk_write_seconds, 3),
         "deserialize_seconds": round(
             cs.deserialize_seconds + ic.deserialize_seconds, 3),
+        "fetch_hits": cs.fetch_hits + ic.fetch_hits,
+        "fetch_corrupt": cs.fetch_corrupt + ic.fetch_corrupt,
     }
     store = net.step_cache.persist or net.infer_cache.persist
     if store is not None:
@@ -718,7 +728,7 @@ def _build_server(args):
 def cmd_serve(args) -> int:
     import signal
 
-    if getattr(args, "replicas", 0) >= 1:
+    if getattr(args, "replicas", 0) >= 1 or getattr(args, "agent", None):
         return cmd_serve_router(args)
     _, server, summary = _build_server(args)
     print(json.dumps(summary), flush=True)
@@ -774,6 +784,28 @@ def _replica_cmd(args) -> List[str]:
     if getattr(args, "precision", "f32") != "f32":
         cmd += ["--precision", args.precision]
     return cmd
+
+
+def _remote_serve_argv(args, cache_sources: List[str]) -> List[str]:
+    """The `serve` argv a ReplicaAgent spawns for one remote replica:
+    the local replica command line minus the interpreter prefix and
+    minus --compile-cache (each agent pins its own host's cache dir),
+    plus --cache-from URLs so a cold host warms over the cachesync wire
+    instead of compiling."""
+    cmd = _replica_cmd(args)[3:]  # drop `python -m deeplearning4j_tpu.cli`
+    argv: List[str] = []
+    skip = False
+    for a in cmd:
+        if skip:
+            skip = False
+            continue
+        if a == "--compile-cache":
+            skip = True
+            continue
+        argv.append(a)
+    for src in cache_sources:
+        argv += ["--cache-from", src]
+    return argv
 
 
 class ReplicaProcess:
@@ -847,10 +879,35 @@ def cmd_serve_router(args) -> int:
     from deeplearning4j_tpu.serving.router import Router
     from deeplearning4j_tpu.serving.supervisor import FleetSupervisor
 
+    agent_urls = list(getattr(args, "agent", None) or [])
+    if agent_urls and args.replicas < 1:
+        args.replicas = 1
     min_replicas = getattr(args, "min_replicas", None) or args.replicas
     max_replicas = getattr(args, "max_replicas", None) or args.replicas
     cmd = _replica_cmd(args)
-    replicas = [ReplicaProcess(cmd) for _ in range(args.replicas)]
+    cache_server = None
+    remote_argv = None
+    clients = []
+    if agent_urls:
+        # multi-host: replicas live on per-host ReplicaAgents; the
+        # supervisor drives them over the network with leases
+        from deeplearning4j_tpu.serving.agent import AgentClient
+        from deeplearning4j_tpu.serving.cachesync import CacheServer
+
+        clients = [AgentClient(u) for u in agent_urls]
+        sources = []
+        if args.compile_cache:
+            # the control-plane host serves its own warmed cache dir
+            # too, so a respawn on a cold host warms over the wire even
+            # when every peer agent is cold (or dead)
+            cache_server = CacheServer(args.compile_cache).start()
+            sources.append(cache_server.url)
+        sources += [c.url for c in clients]
+        remote_argv = _remote_serve_argv(args, sources)
+        replicas = [clients[i % len(clients)].spawn(remote_argv)
+                    for i in range(args.replicas)]
+    else:
+        replicas = [ReplicaProcess(cmd) for _ in range(args.replicas)]
     router = supervisor = autoscaler = None
     try:
         summaries = [r.wait_ready() for r in replicas]
@@ -868,6 +925,8 @@ def cmd_serve_router(args) -> int:
             spawn_fn=lambda: ReplicaProcess(cmd), router=router,
             initial=replicas, min_replicas=min_replicas,
             max_replicas=max_replicas,
+            agents=clients, remote_argv=remote_argv,
+            agent_failover_s=getattr(args, "agent_failover", 10.0),
             drain_timeout_s=getattr(args, "drain_timeout", 10.0)).start()
         if max_replicas > min_replicas:
             autoscaler = Autoscaler(
@@ -881,6 +940,7 @@ def cmd_serve_router(args) -> int:
             "min_replicas": min_replicas,
             "max_replicas": max_replicas,
             "hedge": router.hedge,
+            "agents": [c.url for c in clients],
             "fresh_compiles": [s.get("fresh_compiles") for s in summaries],
             "mesh_devices": summaries[0].get("mesh_devices"),
         }), flush=True)
@@ -910,6 +970,8 @@ def cmd_serve_router(args) -> int:
             supervisor.stop()
         if router is not None:
             router.drain(drain_timeout)
+        if cache_server is not None:
+            cache_server.stop()
         handles = supervisor.handles() if supervisor is not None else replicas
         for r in handles:
             r.terminate()
@@ -930,6 +992,60 @@ def cmd_serve_router(args) -> int:
                           "restarts": fleet.get("restarts_total", 0)}),
               flush=True)
     return 0 if rcs and all(rc == 0 for rc in rcs) else 1
+
+
+def cmd_agent(args) -> int:
+    """agent: the per-host replica-agent control plane.  Runs a small
+    HTTP server (POST /a/spawn, POST /a/stop, GET /a/health,
+    GET /a/replicas, GET /a/cache/{key}) that owns this host's replica
+    subprocesses on behalf of a remote `serve --agent` supervisor.
+    Model-free: the agent never imports jax — replicas are ordinary
+    `serve` subprocesses, and the agent pins each one to this host's
+    --compile-cache dir so they share warm compiles locally and serve
+    them to cold peers over /a/cache."""
+    import signal
+    import threading
+
+    from deeplearning4j_tpu.serving.agent import ReplicaAgent
+
+    def spawn_fn(argv):
+        return ReplicaProcess(
+            [sys.executable, "-m", "deeplearning4j_tpu.cli"] + list(argv))
+
+    agent = ReplicaAgent(spawn_fn, host=args.host, port=args.port,
+                         cache_dir=args.compile_cache,
+                         max_replicas=args.max_replicas).start()
+    print(json.dumps({"url": agent.url,
+                      "compile_cache": args.compile_cache,
+                      "max_replicas": args.max_replicas}), flush=True)
+    stop = threading.Event()
+    prev = {}
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        try:
+            prev[sig] = signal.signal(sig,
+                                      lambda signum, frame: stop.set())
+        except ValueError:
+            pass  # not the main thread: explicit stop only
+    try:
+        while not stop.wait(0.5):
+            pass
+    except KeyboardInterrupt:
+        pass
+    finally:
+        for sig, handler in prev.items():
+            signal.signal(sig, handler)
+        h = agent.health()
+        rcs = agent.stop(terminate_children=True,
+                         drain_timeout_s=getattr(args, "drain_timeout",
+                                                 10.0) + 15.0)
+        print(json.dumps({"drained": True,
+                          "replica_exit_codes": rcs,
+                          "spawns_total": h.get("spawns_total", 0),
+                          "cache_requests_total":
+                              h.get("cache_requests_total", 0),
+                          "cache_hits_total": h.get("cache_hits_total", 0)}),
+              flush=True)
+    return 0
 
 
 def cmd_analyze(args) -> int:
@@ -1306,8 +1422,53 @@ def build_parser() -> argparse.ArgumentParser:
                         "BEFORE warmup so warmed programs carry the "
                         "policy cache key; f32 (default) stays bitwise-"
                         "identical to not passing the flag")
+    s.add_argument("--agent", action="append", default=None, metavar="URL",
+                   help="multi-host: spawn replicas through a ReplicaAgent "
+                        "at URL instead of forking locally (repeatable — "
+                        "one per host; replicas round-robin across "
+                        "agents); supervision becomes lease-based with "
+                        "partition tolerance and failover")
+    s.add_argument("--agent-failover", dest="agent_failover", type=float,
+                   default=10.0, metavar="SECONDS",
+                   help="how long an agent may stay partitioned before "
+                        "its replicas fail over to surviving agents "
+                        "(default 10.0); short partitions just hold "
+                        "replicas out of rotation")
+    s.add_argument("--cache-from", dest="cache_from", action="append",
+                   default=None, metavar="URL",
+                   help="warm the compile cache over the wire: on a local "
+                        "disk miss, fetch the entry from these cachesync "
+                        "URLs (repeatable, tried in order) before "
+                        "compiling; fetched entries are checksum-"
+                        "validated and served from memory")
     _add_generate_flags(s)
     s.set_defaults(fn=cmd_serve)
+
+    ag = sub.add_parser(
+        "agent",
+        help="per-host replica agent: HTTP control plane (POST /a/spawn, "
+             "POST /a/stop, GET /a/health, GET /a/replicas, GET "
+             "/a/cache/{key}) that owns this host's replica subprocesses "
+             "for a remote serve --agent supervisor")
+    ag.add_argument("--host", default="127.0.0.1")
+    ag.add_argument("--port", type=int, default=0,
+                    help="0 picks an ephemeral port (printed in the "
+                         "startup JSON)")
+    ag.add_argument("--compile-cache", dest="compile_cache", default=None,
+                    metavar="DIR",
+                    help="this host's persistent compile cache: every "
+                         "spawned replica is pinned to it, and its "
+                         "checksummed entries are served to cold peers "
+                         "over GET /a/cache/{key}")
+    ag.add_argument("--max-replicas", dest="max_replicas", type=int,
+                    default=4, metavar="N",
+                    help="capacity cap: spawns beyond it get 409 "
+                         "(default 4)")
+    ag.add_argument("--drain-timeout", dest="drain_timeout", type=float,
+                    default=10.0, metavar="SECONDS",
+                    help="bound on each child's SIGTERM graceful drain "
+                         "at agent shutdown")
+    ag.set_defaults(fn=cmd_agent)
 
     an = sub.add_parser(
         "analyze",

@@ -134,7 +134,23 @@ class PersistentProgramStore:
         self.corrupt_evicted = 0
         self.io_errors = 0       # OSErrors downgraded to cache misses
         self.vanished = 0        # entries a sibling process removed first
+        self.fetch_hits = 0      # entries warmed over the wire
+        self.fetch_corrupt = 0   # fetched bytes failing re-validation
+        self.fetch_misses = 0    # remote lookups no peer could serve
         self._io_warned = False  # warn ONCE, then count quietly
+        # optional remote fallback (serving/cachesync.CacheFetcher):
+        # entry filename -> container bytes or None
+        self._remote_fetch = None
+
+    def set_remote(self, fetch_fn) -> None:
+        """Install a remote fetch fallback: on a locally-absent entry,
+        `fetch_fn(filename)` is asked for the container bytes before the
+        caller falls back to compiling.  Fetched bytes go through the
+        SAME magic/header/checksum validation as disk reads — a corrupt
+        or foreign fetch is a counted miss (`fetch_corrupt`), never a
+        crash.  Fetches are served from memory, not written through to
+        disk: the local directory stays this host's own compile record."""
+        self._remote_fetch = fetch_fn
 
     @property
     def platform(self) -> dict:
@@ -174,40 +190,78 @@ class PersistentProgramStore:
             # read and remove — their problem resolved it; plain miss
             self.vanished += 1
 
+    def _validate(self, raw: bytes, key: Tuple, payload_kind: str) -> bytes:
+        """Blob from a container's raw bytes, raising on ANY defect —
+        the one validation path for disk reads and remote fetches alike
+        (the export format doubles as the cachesync wire format)."""
+        if raw[:8] != _MAGIC:
+            raise ValueError("bad magic")
+        (hlen,) = struct.unpack(">I", raw[8:12])
+        header = json.loads(raw[12:12 + hlen].decode("utf-8"))
+        blob = raw[12 + hlen:]
+        if header.get("platform_fingerprint") != self._fingerprint:
+            # foreign artifact (filename hash should prevent this;
+            # header check is defense in depth) — never load it
+            raise ValueError("platform fingerprint mismatch")
+        if header.get("key") != canonical_key(key):
+            raise ValueError("key collision/mismatch")
+        # pre-payload-field entries are all StableHLO programs
+        if header.get("payload", "stablehlo") != payload_kind:
+            raise ValueError("payload kind mismatch")
+        if (header.get("blob_sha256")
+                != hashlib.sha256(blob).hexdigest()):
+            raise ValueError("blob checksum mismatch")
+        return blob
+
+    def _fetch_remote(self, key: Tuple, payload_kind: str):
+        """Remote fallback for a locally-absent entry: ask the
+        configured fetcher for the container by filename and re-validate
+        on arrival.  A peer miss is `fetch_misses`, corrupt/foreign
+        bytes are `fetch_corrupt` — both plain misses, never a crash."""
+        if self._remote_fetch is None:
+            return None
+        name = os.path.basename(self.path_for(key))
+        try:
+            raw = self._remote_fetch(name)
+        except Exception as e:  # noqa: BLE001 — fetcher contract says
+            # never raise, but a broken peer must still read as a miss
+            log.warning("compile-cache: remote fetch of %s failed (%s)",
+                        name, e)
+            self.fetch_misses += 1
+            return None
+        if raw is None:
+            self.fetch_misses += 1
+            return None
+        try:
+            blob = self._validate(raw, key, payload_kind)
+        except Exception as e:  # noqa: BLE001 — corrupt fetch: a miss
+            self.fetch_corrupt += 1
+            log.warning("compile-cache: fetched entry %s failed "
+                        "re-validation (%s); counted miss", name, e)
+            return None
+        self.fetch_hits += 1
+        return blob
+
     def _load_payload(self, key: Tuple, payload_kind: str):
         """Checksum-validated raw blob for `key`, or None.
 
-        None covers every miss flavor: absent file, foreign platform,
-        format bump, payload-kind mismatch, checksum mismatch — the
-        last three also evict the entry so the rewrite is clean."""
+        None covers every miss flavor: absent file (after the remote
+        fallback also missed), foreign platform, format bump,
+        payload-kind mismatch, checksum mismatch — the last three also
+        evict the entry so the rewrite is clean."""
         path = self.path_for(key)
         try:
             faults.fire("persist.read", path=path)
             with open(path, "rb") as f:
                 raw = f.read()
         except (FileNotFoundError, IsADirectoryError):
-            return None
+            # locally absent: a cold host may still warm over the wire
+            return self._fetch_remote(key, payload_kind)
         except OSError as e:
             self._note_io_error("read", path, e)
             return None
         try:
-            if raw[:8] != _MAGIC:
-                raise ValueError("bad magic")
-            (hlen,) = struct.unpack(">I", raw[8:12])
-            header = json.loads(raw[12:12 + hlen].decode("utf-8"))
-            blob = raw[12 + hlen:]
-            if header.get("platform_fingerprint") != self._fingerprint:
-                # foreign artifact (filename hash should prevent this;
-                # header check is defense in depth) — never load it
-                raise ValueError("platform fingerprint mismatch")
-            if header.get("key") != canonical_key(key):
-                raise ValueError("key collision/mismatch")
-            # pre-payload-field entries are all StableHLO programs
-            if header.get("payload", "stablehlo") != payload_kind:
-                raise ValueError("payload kind mismatch")
-            if (header.get("blob_sha256")
-                    != hashlib.sha256(blob).hexdigest()):
-                raise ValueError("blob checksum mismatch")
+            blob = self._validate(raw, key, payload_kind)
         except Exception as e:  # noqa: BLE001 — any bad entry: evict
             self._evict_bad(path, e)
             return None

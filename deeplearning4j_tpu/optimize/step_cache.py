@@ -100,6 +100,8 @@ class StepCacheStats:
         self.disk_write_seconds = 0.0
         self.deserialize_seconds = 0.0
         self.io_errors = 0  # disk faults downgraded to misses (persist)
+        self.fetch_hits = 0     # entries warmed over the wire (persist)
+        self.fetch_corrupt = 0  # fetched bytes failing re-validation
 
     @property
     def total_compile_seconds(self) -> float:
@@ -112,7 +114,9 @@ class StepCacheStats:
                 "disk_hits": self.disk_hits,
                 "disk_write_seconds": round(self.disk_write_seconds, 3),
                 "deserialize_seconds": round(self.deserialize_seconds, 3),
-                "io_errors": self.io_errors}
+                "io_errors": self.io_errors,
+                "fetch_hits": self.fetch_hits,
+                "fetch_corrupt": self.fetch_corrupt}
 
     def __repr__(self):
         return f"StepCacheStats({self.as_dict()})"
@@ -339,7 +343,7 @@ class CompiledProgramCache:
             "mesh": shardings is not None, "shardings": shardings}
         if self._persist is not None:
             fn = self._load_from_disk(key, abstract, donate)
-            self.stats.io_errors = self._persist.io_errors
+            self._sync_persist_counters()
             if fn is not None:
                 return fn
         # armed 'compile' faults fire here: the one place every fresh
@@ -374,9 +378,18 @@ class CompiledProgramCache:
             tw = time.perf_counter()
             self._persist.store(key, exported)
             self.stats.disk_write_seconds += time.perf_counter() - tw
-            self.stats.io_errors = self._persist.io_errors
+            self._sync_persist_counters()
         self._programs[key] = fn
         return fn
+
+    def _sync_persist_counters(self) -> None:
+        """Mirror the store's entry-health counters onto the stats the
+        serving surfaces expose (stores can be shared across caches, so
+        the store owns the truth and the cache snapshots it)."""
+        self.stats.io_errors = self._persist.io_errors
+        self.stats.fetch_hits = getattr(self._persist, "fetch_hits", 0)
+        self.stats.fetch_corrupt = getattr(self._persist,
+                                           "fetch_corrupt", 0)
 
     def _load_from_disk(self, key: Tuple, abstract, donate):
         """Disk half of `_get`: deserialize + AOT-compile a persisted

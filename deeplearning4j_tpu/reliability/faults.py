@@ -138,6 +138,19 @@ DOCUMENTED_POINTS = {
     "tune.load": "tuned-table read from the disk compile cache "
                  "(optimize/tunables.py); a failure degrades to registry "
                  "defaults with one warning — serving never blocks",
+    "agent.spawn": "per remote replica spawn request sent to a "
+                   "ReplicaAgent (serving/agent.py AgentClient.spawn)",
+    "agent.poll": "per agent /a/replicas poll in AgentClient.refresh "
+                  "(serving/agent.py); a failure counts as a missed "
+                  "heartbeat toward the lease",
+    "agent.cache_fetch": "per remote compile-cache entry download "
+                         "(serving/cachesync.py); 'corrupt' flips the "
+                         "fetched bytes so the checksum re-validation "
+                         "path is testable",
+    "agent.partition": "per agent lease heartbeat in FleetSupervisor "
+                       "(serving/supervisor.py); arming 'raise' "
+                       "simulates a network partition between the "
+                       "supervisor and a healthy agent",
 }
 
 _PLAN_RE = re.compile(

@@ -19,7 +19,11 @@ then replicas.
 
 from deeplearning4j_tpu.reliability import (CircuitBreaker, DeadlineExceeded,
                                             RetryBudget)
+from deeplearning4j_tpu.serving.agent import (AgentClient,
+                                              RemoteReplicaHandle,
+                                              ReplicaAgent)
 from deeplearning4j_tpu.serving.autoscaler import Autoscaler
+from deeplearning4j_tpu.serving.cachesync import CacheFetcher, CacheServer
 from deeplearning4j_tpu.serving.batcher import (LATENCY_BUCKETS_S,
                                                 PRIORITIES, MicroBatcher,
                                                 ServerOverloaded)
@@ -31,8 +35,10 @@ from deeplearning4j_tpu.serving.router import Replica, Router
 from deeplearning4j_tpu.serving.server import ModelServer, ServerDraining
 from deeplearning4j_tpu.serving.supervisor import FleetSupervisor
 
-__all__ = ["Autoscaler", "CONTENT_TYPE", "CircuitBreaker",
-           "DeadlineExceeded", "FleetSupervisor", "LATENCY_BUCKETS_S",
-           "MicroBatcher", "ModelServer", "PRIORITIES", "Replica",
-           "RetryBudget", "Router", "ServerDraining", "ServerOverloaded",
-           "parse_prometheus_text", "replica_metrics", "router_metrics"]
+__all__ = ["AgentClient", "Autoscaler", "CONTENT_TYPE", "CacheFetcher",
+           "CacheServer", "CircuitBreaker", "DeadlineExceeded",
+           "FleetSupervisor", "LATENCY_BUCKETS_S", "MicroBatcher",
+           "ModelServer", "PRIORITIES", "Replica", "RemoteReplicaHandle",
+           "ReplicaAgent", "RetryBudget", "Router", "ServerDraining",
+           "ServerOverloaded", "parse_prometheus_text", "replica_metrics",
+           "router_metrics"]

@@ -42,8 +42,12 @@ import threading
 import time
 from typing import Optional
 
-#: decision labels (exported as dl4j_autoscaler_decisions_total{decision=})
-DECISIONS = ("scale_up", "scale_down", "hold")
+#: decision labels (exported as dl4j_autoscaler_decisions_total{decision=}).
+#: `hold_partitioned` is a scale-up the controller REFUSED because slots
+#: are partitioned: the capacity still exists on the far side of a
+#: network partition, and spawning more would double it the moment the
+#: partition heals.
+DECISIONS = ("scale_up", "scale_down", "hold", "hold_partitioned")
 
 
 class Autoscaler:
@@ -107,9 +111,13 @@ class Autoscaler:
             degraded += st.get("degraded_batches", 0)
             if (st.get("breaker", {}) or {}).get("state") == "open":
                 breaker_open = True
+        stats_fn = getattr(self.supervisor, "stats", None)
+        partitioned = (stats_fn().get("states", {}).get("partitioned", 0)
+                       if stats_fn is not None else 0)
         return {"healthy_replicas": healthy, "queue_depth": queue_depth,
                 "p99_ms": p99_ms, "degraded_batches": degraded,
-                "breaker_open": breaker_open}
+                "breaker_open": breaker_open,
+                "partitioned_slots": partitioned}
 
     def _raw_direction(self, sig: dict) -> str:
         n = max(sig["healthy_replicas"], 1)
@@ -151,8 +159,15 @@ class Autoscaler:
                     self._streak = 1
                 act = (raw if raw != "hold"
                        and self._streak >= self.consecutive else "hold")
+            if act == "scale_up" and sig.get("partitioned_slots", 0) > 0:
+                # partitioned capacity is unreachable, NOT gone: growing
+                # now would double it when the lease heals and the
+                # supervisor adopts the replicas back.  Count the refusal
+                # (no cooldown — the moment the partition resolves, the
+                # built streak may act).
+                act = "hold_partitioned"
             self._decisions[act] += 1
-            if act != "hold":
+            if act in ("scale_up", "scale_down"):
                 self._cooldown_until = now + self.cooldown_s
                 self._streak = 0
                 self._streak_dir = "hold"

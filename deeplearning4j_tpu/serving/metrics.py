@@ -63,6 +63,8 @@ FAMILIES = {
     "dl4j_serving_cache_misses_total": ("counter", ("policy",)),
     "dl4j_serving_cache_disk_hits_total": ("counter", ("policy",)),
     "dl4j_serving_cache_io_errors_total": ("counter", ("policy",)),
+    "dl4j_serving_cache_fetch_hits_total": ("counter", ("policy",)),
+    "dl4j_serving_cache_fetch_corrupt_total": ("counter", ("policy",)),
     "dl4j_serving_tokens_total": ("counter", ()),
     "dl4j_serving_ttft_seconds": ("histogram", ()),
     "dl4j_serving_decode_slots": ("gauge", ("state",)),
@@ -87,11 +89,23 @@ FAMILIES = {
     "dl4j_router_replica_healthy": ("gauge", ("replica",)),
     "dl4j_router_replica_breaker_state": ("gauge", ("replica",)),
     "dl4j_router_replica_stats_age_seconds": ("gauge", ("replica",)),
+    "dl4j_router_host_replicas": ("gauge", ("host",)),
+    "dl4j_router_host_breaker_opens_total": ("counter", ("host",)),
     "dl4j_tuning_table_info": ("gauge", ("device_kind",)),
     "dl4j_tuning_fresh_tunes_total": ("counter", ()),
     "dl4j_fleet_replicas": ("gauge", ("state",)),
     "dl4j_fleet_restarts_total": ("counter", ()),
     "dl4j_fleet_spawn_failures_total": ("counter", ()),
+    "dl4j_fleet_quarantine_remaining_seconds": ("gauge", ("slot",)),
+    "dl4j_fleet_partitions_total": ("counter", ()),
+    "dl4j_fleet_failovers_total": ("counter", ()),
+    "dl4j_agent_up": ("gauge", ("agent",)),
+    "dl4j_agent_replicas": ("gauge", ("agent",)),
+    "dl4j_agent_partitions_total": ("counter", ("agent",)),
+    "dl4j_agent_reconciles_total": ("counter", ("agent",)),
+    "dl4j_agent_adopted_total": ("counter", ("agent",)),
+    "dl4j_agent_orphans_stopped_total": ("counter", ("agent",)),
+    "dl4j_agent_failovers_total": ("counter", ("agent",)),
     "dl4j_autoscaler_decisions_total": ("counter", ("decision",)),
     "dl4j_autoscaler_target_replicas": ("gauge", ()),
 }
@@ -291,6 +305,14 @@ def replica_metrics(stats: dict, page: Optional[PrometheusText] = None,
     p.counter("dl4j_serving_cache_io_errors_total",
               "Disk-cache I/O errors downgraded to misses.",
               cache.get("io_errors", 0), lbl(policy=policy))
+    p.counter("dl4j_serving_cache_fetch_hits_total",
+              "Programs warmed over the cachesync wire from a peer's "
+              "compile cache (fetched, validated, never compiled).",
+              cache.get("fetch_hits", 0), lbl(policy=policy))
+    p.counter("dl4j_serving_cache_fetch_corrupt_total",
+              "Remote cache fetches that failed checksum re-validation "
+              "on arrival (downgraded to counted misses).",
+              cache.get("fetch_corrupt", 0), lbl(policy=policy))
     tuning = stats.get("tuning")
     if tuning:
         # info-style: the value is the installed-table count (0/1), the
@@ -433,6 +455,15 @@ def router_metrics(stats: dict) -> str:
         # them off the page rather than exporting a dead replica as live
         if rep_stats and not rep.get("stale"):
             replica_metrics(rep_stats, page=p, labels=rl)
+    for host, hs in sorted(stats.get("hosts", {}).items()):
+        hl = {"host": host}
+        p.gauge("dl4j_router_host_replicas",
+                "Registered replicas per host (failure domain).",
+                hs.get("replicas", 0), hl)
+        p.counter("dl4j_router_host_breaker_opens_total",
+                  "Routing-breaker trips aggregated per host — a dying "
+                  "host is one signal, not N replica signals.",
+                  hs.get("breaker_opens", 0), hl)
     fleet = stats.get("fleet")
     if fleet:
         for state, n in sorted(fleet.get("states", {}).items()):
@@ -445,6 +476,46 @@ def router_metrics(stats: dict) -> str:
         p.counter("dl4j_fleet_spawn_failures_total",
                   "Respawn attempts that failed before the replica "
                   "became ready.", fleet.get("spawn_failures_total", 0))
+        p.counter("dl4j_fleet_partitions_total",
+                  "Agent leases lost to missed heartbeats (the "
+                  "supervisor marked the agent partitioned).",
+                  fleet.get("partitions_total", 0))
+        p.counter("dl4j_fleet_failovers_total",
+                  "Slots failed over to a surviving agent after a "
+                  "partition outlived the failover deadline.",
+                  fleet.get("failovers_total", 0))
+        for slot in fleet.get("slots", []):
+            p.gauge("dl4j_fleet_quarantine_remaining_seconds",
+                    "Seconds until a quarantined slot's probe respawn "
+                    "unlocks (0 for non-quarantined slots).",
+                    slot.get("quarantine_remaining_s", 0.0),
+                    {"slot": str(slot.get("id"))})
+        for ag in fleet.get("agents", []):
+            al = {"agent": ag.get("host") or ag.get("url") or ""}
+            p.gauge("dl4j_agent_up",
+                    "1 while the agent's lease is held (0: partitioned).",
+                    1 if ag.get("state") == "leased" else 0, al)
+            p.gauge("dl4j_agent_replicas",
+                    "Live replicas on the agent per its last good "
+                    "snapshot.", ag.get("replicas_live", 0), al)
+            p.counter("dl4j_agent_partitions_total",
+                      "Times this agent's lease was lost.",
+                      ag.get("partitions_total", 0), al)
+            p.counter("dl4j_agent_reconciles_total",
+                      "Lease re-acquisitions that reconciled agent "
+                      "state against supervisor intent.",
+                      ag.get("reconciles_total", 0), al)
+            p.counter("dl4j_agent_adopted_total",
+                      "Still-live replicas adopted back into rotation "
+                      "after a partition healed (never respawned).",
+                      ag.get("adopted_total", 0), al)
+            p.counter("dl4j_agent_orphans_stopped_total",
+                      "Live agent children stopped at reconcile because "
+                      "no slot intends them anymore.",
+                      ag.get("orphans_stopped_total", 0), al)
+            p.counter("dl4j_agent_failovers_total",
+                      "Slots this agent lost to failover while "
+                      "partitioned.", ag.get("failovers_total", 0), al)
     autoscaler = stats.get("autoscaler")
     if autoscaler:
         for decision, n in sorted(autoscaler.get("decisions", {}).items()):

@@ -98,9 +98,14 @@ class Replica:
     SUCCEEDED, so consumers can tell live state from a stale cache)."""
 
     def __init__(self, index: int, url: str,
-                 breaker: Optional[CircuitBreaker] = None):
+                 breaker: Optional[CircuitBreaker] = None,
+                 host: Optional[str] = None):
         self.index = int(index)
         self.url = url.rstrip("/")
+        # failure-domain label: which HOST (agent) serves this replica.
+        # Hedges/retries prefer a different host than the primary, and
+        # breaker trips aggregate per host in the router stats.
+        self.host = host or "local"
         # trips after a few consecutive proxy failures; short reset so a
         # restarted replica rejoins within a couple of poll intervals
         self.breaker = breaker if breaker is not None else CircuitBreaker(
@@ -170,6 +175,7 @@ class Replica:
             out = {
                 "index": self.index,
                 "url": self.url,
+                "host": self.host,
                 "healthy": self._ready,
                 "last_ok_poll_age_s": (None if age is None
                                        else round(age, 3)),
@@ -322,11 +328,12 @@ class Router:
                           for p in PRIORITIES}
 
     # -- fleet mutation ------------------------------------------------------
-    def add_replica(self, url: str) -> Replica:
+    def add_replica(self, url: str, host: Optional[str] = None) -> Replica:
         """Register a replica URL (a fresh spawn or a respawn on a new
-        ephemeral port) and put it in rotation once it polls ready."""
+        ephemeral port) and put it in rotation once it polls ready.
+        `host` is the failure-domain label (the agent serving it)."""
         with self._state_lock:
-            rep = Replica(self._next_index, url)
+            rep = Replica(self._next_index, url, host=host)
             self._next_index += 1
             self.replicas = self.replicas + [rep]
         rep.poll()  # outside the lock: readiness known before first route
@@ -398,7 +405,25 @@ class Router:
             return []
         order = [reps[(start + i) % len(reps)] for i in range(len(reps))]
         routable = [r for r in order if r.routable()]
-        return routable or [r for r in order if r.ready]
+        return self._prefer_other_hosts(
+            routable or [r for r in order if r.ready])
+
+    @staticmethod
+    def _prefer_other_hosts(rotation: List[Replica]) -> List[Replica]:
+        """Failure-domain ordering: keep the round-robin primary, but
+        sort the tail so hedges and retries land on a DIFFERENT host
+        than the primary first — a host-level failure (dead agent,
+        partition) then cannot eat both the attempt and its backup.
+        Single-host fleets are untouched (the tail is homogeneous)."""
+        if len(rotation) < 3:
+            return rotation
+        primary = rotation[0]
+        tail = rotation[1:]
+        other = [r for r in tail if r.host != primary.host]
+        if not other or len(other) == len(tail):
+            return rotation
+        same = [r for r in tail if r.host == primary.host]
+        return [primary] + other + same
 
     @staticmethod
     def _request_priority(raw: bytes) -> str:
@@ -615,6 +640,22 @@ class Router:
         out["replicas"] = [r.describe(self.stats_staleness_s)
                            for r in self.replicas]
         out["healthy_replicas"] = self.healthy_count()
+        # per-host (failure-domain) rollup: breaker trips aggregated by
+        # the host label, so a dying HOST reads as one signal even when
+        # its replicas trip breakers one by one
+        hosts: dict = {}
+        for rep in out["replicas"]:
+            h = hosts.setdefault(rep.get("host") or "local",
+                                 {"replicas": 0, "healthy": 0,
+                                  "breaker_opens": 0, "breakers_open": 0})
+            h["replicas"] += 1
+            if rep.get("healthy"):
+                h["healthy"] += 1
+            brk = rep.get("breaker") or {}
+            h["breaker_opens"] += int(brk.get("opens", 0))
+            if brk.get("state") == "open":
+                h["breakers_open"] += 1
+        out["hosts"] = hosts
         # fleet-wide per-precision-policy rows, aggregated from each
         # replica's last-polled /v1/stats precision block (the
         # policy-labeled Prometheus re-export keeps the per-replica

@@ -36,14 +36,43 @@ deterministic.  `FleetSupervisor` owns the replica process set behind
             answers everything it had accepted, so scale-down provably
             drops zero requests.
 
+Multi-host (ROADMAP item 5): with `agents=[...]` the same slots are
+backed by `RemoteReplicaHandle`s driven through per-host `ReplicaAgent`
+control planes (serving/agent.py) instead of local forks.  Remote
+supervision is LEASE-BASED, because a network edge fails in a way a
+local `poll()` cannot — the agent may be fine while the path to it is
+not:
+
+  lease     every tick heartbeats each agent once (`/a/replicas`,
+            explicit timeout); a success refreshes the exit-code
+            snapshot every remote handle's non-blocking `poll()` reads.
+  partition `lease_misses` consecutive failed heartbeats mark the agent
+            PARTITIONED: its running slots move to the "partitioned"
+            state and leave the router rotation — unreachable is not
+            dead, so nothing is respawned yet (respawning a replica
+            that is still serving on the far side would double-spawn).
+  failover  a partition older than `agent_failover_s` is treated as a
+            lost host: its slots book a death and respawn onto the
+            surviving leased agents (round-robin), warming over the
+            cachesync wire instead of compiling.
+  reconcile when a partitioned agent's lease is re-acquired, actual
+            agent state is reconciled against intent: still-live
+            replicas are ADOPTED back into rotation (never respawned),
+            dead ones book a normal death, and live agent children the
+            supervisor no longer intends (a slot failed over meanwhile)
+            are stopped — zero double-spawns either way.
+
 Lock ordering: the supervisor calls `router.add_replica`/
 `remove_replica` (which take the router's `_state_lock`) only OUTSIDE
 its own `_lock`, and the router calls `supervisor.stats()` without
-holding its state lock — no lock cycle exists.
+holding its state lock — no lock cycle exists.  Agent heartbeats are
+network calls and also happen outside `_lock`.
 
 Fault-injection: every (re)spawn traverses the ``supervisor.spawn``
 point (reliability/faults.py); arming it is how the quarantine tests
-make respawns fail deterministically.
+make respawns fail deterministically.  Every agent heartbeat traverses
+``agent.partition`` — arming `raise` there simulates a network
+partition between the supervisor and a perfectly healthy agent.
 
 `spawn_fn` is any zero-arg callable returning a process handle with the
 `ReplicaProcess` surface (`wait_ready()`, `url`, `poll()`,
@@ -63,8 +92,46 @@ from deeplearning4j_tpu.reliability import faults
 
 #: slot lifecycle states (exported as dl4j_fleet_replicas{state=...};
 #: every state is always exported, zeros included, so dashboards see a
-#: stable label set)
-STATES = ("running", "backoff", "quarantined", "stopped")
+#: stable label set).  "partitioned" is remote-only: the replica is
+#: unreachable but not known dead, so it is out of rotation yet NOT
+#: respawned until the lease failover deadline passes.
+STATES = ("running", "backoff", "quarantined", "stopped", "partitioned")
+
+
+class _AgentState:
+    """One remote agent as the supervisor leases it."""
+
+    def __init__(self, client):
+        if isinstance(client, str):
+            from deeplearning4j_tpu.serving.agent import AgentClient
+
+            client = AgentClient(client)
+        self.client = client
+        self.url = client.url
+        #: failure-domain label shared by every replica this agent hosts
+        self.host = getattr(client, "host", client.url)
+        self.state = "leased"            # or "partitioned"
+        self.missed = 0                  # consecutive failed heartbeats
+        self.last_ok: Optional[float] = None
+        self.partitioned_at: Optional[float] = None
+        self.replicas_live = 0           # from the last good snapshot
+        self.partitions_total = 0
+        self.reconciles_total = 0
+        self.adopted_total = 0
+        self.orphans_stopped_total = 0
+        self.failovers_total = 0
+
+    def describe(self) -> dict:
+        return {
+            "url": self.url, "host": self.host, "state": self.state,
+            "missed_heartbeats": self.missed,
+            "replicas_live": self.replicas_live,
+            "partitions_total": self.partitions_total,
+            "reconciles_total": self.reconciles_total,
+            "adopted_total": self.adopted_total,
+            "orphans_stopped_total": self.orphans_stopped_total,
+            "failovers_total": self.failovers_total,
+        }
 
 
 class _Slot:
@@ -76,6 +143,8 @@ class _Slot:
         self.handle = None
         self.url: Optional[str] = None
         self.state = "stopped"
+        self.host = "local"              # failure-domain label
+        self.agent: Optional[_AgentState] = None
         self.deaths: deque = deque()     # timestamps inside the window
         self.attempt = 0                 # consecutive failed comebacks
         self.restarts = 0
@@ -85,19 +154,35 @@ class _Slot:
         self.summary: Optional[dict] = None
 
     def describe(self, now: float) -> dict:
+        quarantined = (self.state == "quarantined"
+                       and self.next_spawn_at is not None)
         return {
             "id": self.id,
             "url": self.url,
             "state": self.state,
+            "host": self.host,
+            "agent": self.agent.url if self.agent is not None else None,
             "restarts": self.restarts,
             "deaths_in_window": len(self.deaths),
             "last_exit": self.last_exit,
             # the respawn warms from the shared disk cache: this staying
             # 0 across restarts is the "seconds, not compiles" proof
             "fresh_compiles": (self.summary or {}).get("fresh_compiles"),
+            # ... and for a REMOTE respawn the warmth arrived over the
+            # cachesync wire: fetch hits > 0 with fresh_compiles == 0
+            # is the "warmed, never compiled" proof
+            "cache_fetch_hits": ((self.summary or {})
+                                 .get("disk_cache") or {}).get("fetch_hits"),
             "backoff_remaining_s": (
                 None if self.next_spawn_at is None
                 else round(max(self.next_spawn_at - now, 0.0), 3)),
+            # on the supervisor's own clock (monotonic): when the
+            # quarantine probe unlocks, and how far away that is
+            "quarantined_until": (self.next_spawn_at if quarantined
+                                  else None),
+            "quarantine_remaining_s": (
+                round(max(self.next_spawn_at - now, 0.0), 3)
+                if quarantined else 0.0),
         }
 
 
@@ -116,6 +201,15 @@ class FleetSupervisor:
                       deaths inside the window quarantines the slot.
     quarantine_s:     how long a quarantined slot sits out before one
                       probe respawn.
+    agents:           remote `AgentClient`s (or agent base URLs) — when
+                      non-empty the fleet is remote: spawns go through
+                      the agents and supervision is lease-based.
+    remote_argv:      the `serve` argv spawned on an agent for every
+                      remote (re)spawn.
+    lease_misses:     consecutive failed heartbeats before an agent is
+                      marked partitioned.
+    agent_failover_s: how long a partition may last before its slots
+                      fail over to the surviving agents.
     backoff_fn:       (attempt) -> seconds; injectable so tests collapse
                       the jittered waits.
     clock:            injectable monotonic clock for deterministic tests.
@@ -127,6 +221,8 @@ class FleetSupervisor:
                  max_restarts: int = 5, restart_window_s: float = 30.0,
                  quarantine_s: float = 60.0,
                  drain_timeout_s: float = 10.0,
+                 agents=(), remote_argv=None,
+                 lease_misses: int = 3, agent_failover_s: float = 30.0,
                  backoff_fn: Callable[[int], float] = backoff_seconds,
                  clock=time.monotonic):
         if max_replicas < min_replicas:
@@ -140,10 +236,15 @@ class FleetSupervisor:
         self.restart_window_s = float(restart_window_s)
         self.quarantine_s = float(quarantine_s)
         self.drain_timeout_s = float(drain_timeout_s)
+        self.remote_argv = list(remote_argv) if remote_argv else None
+        self.lease_misses = int(lease_misses)
+        self.agent_failover_s = float(agent_failover_s)
         self.backoff_fn = backoff_fn
         self._clock = clock
         self._lock = threading.Lock()
         self._slots: List[_Slot] = []
+        self._agents: List[_AgentState] = [_AgentState(a) for a in agents]
+        self._agent_rr = 0
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._restarts_total = 0
@@ -155,17 +256,63 @@ class FleetSupervisor:
             slot.url = handle.url
             slot.summary = getattr(handle, "summary", None)
             slot.state = "running"
+            # a RemoteReplicaHandle carries its AgentClient: bind the
+            # slot to the matching lease so partitions find it
+            client = getattr(handle, "client", None)
+            if client is not None:
+                ast = self._agent_for(client)
+                slot.agent = ast
+                slot.host = ast.host
             self._slots.append(slot)
 
+    def _agent_for(self, client) -> _AgentState:
+        for ast in self._agents:
+            if ast.client is client or ast.url == getattr(client, "url",
+                                                          None):
+                return ast
+        ast = _AgentState(client)
+        self._agents.append(ast)
+        return ast
+
     # -- spawning ------------------------------------------------------------
+    def _pick_agent_locked(self) -> Optional[_AgentState]:
+        """Next leased agent, round-robin; None when every agent is
+        partitioned (the caller re-backoffs).  Caller holds `_lock`."""
+        leased = [a for a in self._agents if a.state == "leased"]
+        if not leased:
+            return None
+        agent = leased[self._agent_rr % len(leased)]
+        self._agent_rr += 1
+        return agent
+
     def _spawn_into(self, slot: _Slot) -> bool:
         """(Re)fill `slot` with a fresh process and put its URL in
         rotation.  Called WITHOUT `_lock` held (spawning blocks on
         warmup; router registration takes the router's lock).  Returns
-        False — and books the death — when the spawn itself fails."""
+        False — and books the death — when the spawn itself fails.
+
+        Remote fleets spawn through an agent: the slot's own agent when
+        its lease is good, otherwise the next leased agent round-robin
+        (this is the failover path landing on a surviving host)."""
+        agent: Optional[_AgentState] = None
+        if self._agents:
+            with self._lock:
+                agent = slot.agent if (slot.agent is not None
+                                       and slot.agent.state == "leased") \
+                    else self._pick_agent_locked()
+                if agent is None:
+                    # every agent is partitioned: nothing to spawn ON;
+                    # stay in backoff and retry when a lease comes back
+                    slot.state = "backoff"
+                    slot.next_spawn_at = self._clock() + self.backoff_fn(
+                        max(slot.attempt, 1))
+                    return False
         try:
             faults.fire("supervisor.spawn", slot=slot.id)
-            handle = self.spawn_fn()
+            if agent is not None:
+                handle = agent.client.spawn(self.remote_argv)
+            else:
+                handle = self.spawn_fn()
             summary = handle.wait_ready()
         except BaseException as e:  # noqa: BLE001 — incl. SystemExit from
             # wait_ready on a child that died during startup: a spawn
@@ -187,7 +334,11 @@ class FleetSupervisor:
             slot.next_spawn_at = None
             slot.quarantined_at = None
             slot.attempt = 0
-        self.router.add_replica(url)
+            if agent is not None:
+                slot.agent = agent
+                slot.host = agent.host
+            host = slot.host
+        self.router.add_replica(url, host=host)
         return True
 
     def _schedule_locked(self, slot: _Slot, now: float,
@@ -208,12 +359,127 @@ class FleetSupervisor:
             slot.next_spawn_at = now + self.backoff_fn(
                 max(slot.attempt, 1))
 
+    # -- the lease machinery (remote fleets) ----------------------------------
+    def _tick_agents(self, now: float) -> None:
+        """One lease pass: heartbeat every agent (network, OUTSIDE
+        `_lock`), then apply partition / failover / heal+reconcile
+        transitions under `_lock`, then do the router mutations and
+        orphan stops outside it again (lock ordering)."""
+        if not self._agents:
+            return
+        beats = []
+        for ast in self._agents:
+            try:
+                # an armed 'raise' here IS a partition: the agent stays
+                # healthy, only the supervisor's view of it goes dark
+                faults.fire("agent.partition", agent=ast.url)
+                beats.append((ast, ast.client.refresh()))
+            except Exception:  # noqa: BLE001 — unreachable/armed: a
+                beats.append((ast, None))  # missed heartbeat, not a crash
+        to_remove: List[str] = []
+        to_add: List[tuple] = []           # (url, host)
+        orphan_stops: List[tuple] = []     # (client, rid)
+        with self._lock:
+            for ast, records in beats:
+                if records is None:
+                    ast.missed += 1
+                    if (ast.state == "leased"
+                            and ast.missed >= self.lease_misses):
+                        ast.state = "partitioned"
+                        ast.partitioned_at = now
+                        ast.partitions_total += 1
+                        for slot in self._slots:
+                            if slot.agent is ast and \
+                                    slot.state == "running":
+                                slot.state = "partitioned"
+                                if slot.url:
+                                    to_remove.append(slot.url)
+                    if (ast.state == "partitioned"
+                            and now - ast.partitioned_at
+                            >= self.agent_failover_s):
+                        # the host is lost as far as the fleet cares:
+                        # fail its slots over to the surviving agents
+                        for slot in self._slots:
+                            if slot.agent is ast and \
+                                    slot.state == "partitioned":
+                                ast.failovers_total += 1
+                                slot.attempt += 1
+                                slot.deaths.append(now)
+                                slot.last_exit = None
+                                slot.handle = None
+                                slot.agent = None
+                                self._schedule_locked(slot, now)
+                    continue
+                healed = ast.state == "partitioned"
+                ast.state = "leased"
+                ast.missed = 0
+                ast.last_ok = now
+                ast.replicas_live = sum(1 for r in records
+                                        if r.get("alive"))
+                if healed:
+                    adds, stops = self._reconcile_locked(ast, records,
+                                                         now)
+                    to_add.extend(adds)
+                    orphan_stops.extend(stops)
+        for url in to_remove:
+            self.router.remove_replica(url)
+        for url, host in to_add:
+            if self.router.find_replica(url) is None:
+                self.router.add_replica(url, host=host)
+        for client, rid in orphan_stops:
+            try:
+                client.stop(rid, wait=False)
+            except Exception:  # noqa: BLE001 — best-effort cleanup
+                pass
+
+    def _reconcile_locked(self, ast: _AgentState, records, now: float):
+        """Re-acquired lease: align the agent's ACTUAL replica set with
+        the supervisor's intent.  Still-live replicas are adopted back
+        (never respawned — that is the zero-double-spawn guarantee),
+        dead ones book a normal death, and live agent children no slot
+        intends anymore (failed over during the partition) are stopped.
+        Caller holds `_lock`; returns (router adds, orphan stops) for
+        the caller to apply outside it."""
+        ast.reconciles_total += 1
+        by_id = {r.get("id"): r for r in records}
+        held = set()
+        adds: List[tuple] = []
+        for slot in self._slots:
+            if slot.agent is not ast or slot.handle is None:
+                continue
+            rid = getattr(slot.handle, "rid", None)
+            held.add(rid)
+            if slot.state != "partitioned":
+                continue
+            rec = by_id.get(rid)
+            if rec is not None and rec.get("alive"):
+                slot.state = "running"
+                ast.adopted_total += 1
+                if slot.url:
+                    adds.append((slot.url, ast.host))
+            else:
+                # died while we could not see it: a normal death, seen
+                # late — book it and let the backoff machinery respawn
+                slot.last_exit = (rec or {}).get("exit_code")
+                slot.attempt += 1
+                slot.deaths.append(now)
+                slot.handle = None
+                self._schedule_locked(slot, now)
+        stops = [(ast.client, r.get("id")) for r in records
+                 if r.get("alive") and r.get("id") not in held]
+        ast.orphans_stopped_total += len(stops)
+        return adds, stops
+
     # -- the supervision loop -------------------------------------------------
     def tick(self) -> None:
-        """One supervision pass: reap deaths, start due respawns.
-        Public so tests drive it deterministically; the background
-        thread just calls it on `poll_interval_s`."""
+        """One supervision pass: heartbeat the agent leases, reap
+        deaths, start due respawns.  Public so tests drive it
+        deterministically; the background thread just calls it on
+        `poll_interval_s`."""
         now = self._clock()
+        # leases first: the heartbeat refreshes every remote handle's
+        # exit-code snapshot, so the poll loop below reads fresh state
+        self._tick_agents(now)
         dead: List[_Slot] = []
         due: List[_Slot] = []
         with self._lock:
@@ -271,10 +537,12 @@ class FleetSupervisor:
 
     def scale_down(self) -> bool:
         """Remove one replica without dropping a single request: pick
-        the emptiest RUNNING replica (lowest last-polled queue depth),
-        pull it from rotation FIRST, then SIGTERM — its own graceful
-        drain answers everything already accepted.  Refuses below
-        `min_replicas`."""
+        the emptiest RUNNING replica on the MOST-LOADED host (highest
+        total last-polled queue depth) — shrinking the hot failure
+        domain first keeps load spread across hosts — pull it from
+        rotation FIRST, then SIGTERM: its own graceful drain answers
+        everything already accepted.  Refuses below `min_replicas`.
+        Single-host fleets degenerate to plain emptiest-replica."""
         with self._lock:
             running = [s for s in self._slots if s.state == "running"]
             if len(running) <= self.min_replicas:
@@ -288,7 +556,13 @@ class FleetSupervisor:
                 return sum(p.get("queue_depth", 0)
                            for p in st.get("priorities", {}).values())
 
-            victim = min(running, key=queue_depth)
+            by_host: Dict[str, List[_Slot]] = {}
+            for s in running:
+                by_host.setdefault(s.host, []).append(s)
+            target = max(by_host.values(),
+                         key=lambda group: sum(queue_depth(s)
+                                               for s in group))
+            victim = min(target, key=queue_depth)
             victim.state = "draining"  # off-limits to tick() reaping
         self.router.remove_replica(victim.url)
         handle = victim.handle
@@ -341,5 +615,12 @@ class FleetSupervisor:
                 "restarts_total": self._restarts_total,
                 "spawn_failures_total": self._spawn_failures_total,
                 "quarantines_total": self._quarantines_total,
+                "partitions_total": sum(a.partitions_total
+                                        for a in self._agents),
+                "failovers_total": sum(a.failovers_total
+                                       for a in self._agents),
+                "adopted_total": sum(a.adopted_total
+                                     for a in self._agents),
+                "agents": [a.describe() for a in self._agents],
                 "slots": [s.describe(now) for s in self._slots],
             }

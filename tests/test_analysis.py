@@ -305,6 +305,35 @@ def test_wall_clock_rule_scoped_to_clocked_modules():
     assert lint_source(mono, "serving/x.py") == []
 
 
+def test_unbounded_network_call_rule_both_directions():
+    # direction 1: a serving/ network call with no explicit bound hangs
+    # the whole control plane on one dead peer — error
+    src = ("import urllib.request\n"
+           "r = urllib.request.urlopen(url)\n")
+    fs = lint_source(src, "serving/x.py")
+    assert _rules(fs) == ["unbounded-network-call"]
+    assert fs[0].severity == "error"
+    sock = "import socket\ns = socket.create_connection((host, port))\n"
+    assert _rules(lint_source(sock, "serving/x.py")) == [
+        "unbounded-network-call"]
+    # direction 2: explicit timeouts (kwarg or positional), out-of-scope
+    # modules, and waived calls are all clean
+    bounded = ("import urllib.request\n"
+               "r = urllib.request.urlopen(url, timeout=2.0)\n")
+    assert lint_source(bounded, "serving/x.py") == []
+    sock_kw = ("import socket\n"
+               "s = socket.create_connection((host, port), timeout=1.0)\n")
+    assert lint_source(sock_kw, "serving/x.py") == []
+    sock_pos = ("import socket\n"
+                "s = socket.create_connection((host, port), 1.0)\n")
+    assert lint_source(sock_pos, "serving/x.py") == []
+    assert lint_source(src, "cli/x.py") == []  # bench/CLI clients: out of scope
+    waived = ("import urllib.request\n"
+              "r = urllib.request.urlopen(url)"
+              "  # lint: allow(unbounded-network-call)\n")
+    assert lint_source(waived, "serving/x.py") == []
+
+
 def test_f64_literal_and_default_dtype_rules():
     src = "import numpy as np\na = np.zeros((3,), np.float64)\n"
     fs = lint_source(src, "nn/x.py")

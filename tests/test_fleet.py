@@ -28,7 +28,10 @@ from deeplearning4j_tpu.models.zoo import mlp
 from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
 from deeplearning4j_tpu.parallel import checkpoint
 from deeplearning4j_tpu.reliability import RetryBudget, faults
-from deeplearning4j_tpu.serving import (Autoscaler, FleetSupervisor, Router,
+from deeplearning4j_tpu.serving import (AgentClient, Autoscaler,
+                                        CacheFetcher, CacheServer,
+                                        CircuitBreaker, FleetSupervisor,
+                                        ReplicaAgent, Router,
                                         parse_prometheus_text,
                                         router_metrics)
 
@@ -565,6 +568,360 @@ def test_autoscaler_scales_down_idle_fleet_and_p99_breach_up():
     assert a2.evaluate_once() == "scale_up"
 
 
+class _PartSupProbe(_SupProbe):
+    """Supervisor probe that also reports partitioned slots."""
+
+    def __init__(self, partitioned=1):
+        super().__init__()
+        self.partitioned = partitioned
+
+    def stats(self):
+        return {"states": {"partitioned": self.partitioned}}
+
+
+def test_autoscaler_holds_partitioned_capacity():
+    clk = _FakeClock()
+    sup = _PartSupProbe(partitioned=1)
+    hot = _RouterProbe([_RepProbe(queue_depth=100), _RepProbe()])
+    a = Autoscaler(hot, sup, slo_p99_ms=500.0, consecutive=2,
+                   cooldown_s=30.0, clock=clk)
+    assert a.evaluate_once() == "hold"            # streak building
+    # streak satisfied, but partitioned capacity still exists on the far
+    # side of the partition: the scale-up is REFUSED, not just delayed
+    assert a.evaluate_once() == "hold_partitioned"
+    assert sup.ups == 0
+    # no cooldown was taken — the moment the partition resolves, the
+    # already-built streak acts immediately
+    sup.partitioned = 0
+    assert a.evaluate_once() == "scale_up"
+    assert sup.ups == 1
+    assert a.stats()["decisions"]["hold_partitioned"] == 1
+
+
+# -- replica agent: the per-host control plane (ISSUE 20) ---------------------
+
+def _start_agents(n_agents=1, max_replicas=4):
+    """In-process agents whose spawn_fn makes real in-process replicas
+    (`_Handle` wraps a warmed `ModelServer`); returns (agents, spawned)."""
+    spawned = []
+
+    def spawn_fn(argv):
+        assert argv and argv[0] == "serve"
+        h = _Handle()
+        spawned.append(h)
+        return h
+
+    agents = [ReplicaAgent(spawn_fn, max_replicas=max_replicas).start()
+              for _ in range(n_agents)]
+    return agents, spawned
+
+
+def _stop_agents(agents):
+    for a in agents:
+        a.stop(terminate_children=True, drain_timeout_s=5.0)
+
+
+def test_agent_control_plane_spawn_stop_and_clean_errors():
+    agents, spawned = _start_agents(max_replicas=1)
+    agent = agents[0]
+    try:
+        client = AgentClient(agent.url, timeout_s=5.0)
+        h = client.spawn(["serve"])
+        assert h.url and h.poll() is None
+        assert h.wait_ready()["url"] == h.url
+        assert agent.health()["replicas"] == 1
+        # capacity bound: the agent is a bounded nursery, not a fork bomb
+        with pytest.raises(RuntimeError, match="409"):
+            client.spawn(["serve"])
+        # only `serve` argv is accepted — the agent is not a remote shell
+        code, text = _http(agent.url + "/a/spawn", {"argv": ["rm", "-rf"]})
+        assert code == 400 and "error" in json.loads(text)
+        # malformed JSON body -> clean 400, not a handler crash
+        req = urllib.request.Request(
+            agent.url + "/a/spawn", data=b"{not json",
+            headers={"Content-Type": "application/json"})
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(req, timeout=10)
+        assert e.value.code == 400
+        # unknown replica id -> 404
+        code, _ = _http(agent.url + "/a/stop", {"id": 99})
+        assert code == 404
+        # unknown path -> 404 JSON
+        code, text = _http(agent.url + "/a/nope")
+        assert code == 404 and "error" in json.loads(text)
+        # graceful stop reports the drained exit code; the snapshot and
+        # the remote handle's poll() see it
+        out = client.stop(h.rid, wait=True)
+        assert out["exit_code"] == 0
+        assert h.poll() == 0
+        recs = client.refresh()
+        assert [r["alive"] for r in recs] == [False]
+        assert agent.health()["replicas"] == 0
+        # a vacated slot frees capacity again
+        h2 = client.spawn(["serve"])
+        assert h2.rid != h.rid
+        assert agent.health()["spawns_total"] == 2
+    finally:
+        _stop_agents(agents)
+
+
+def test_agent_serves_cache_entries_with_counters(tmp_path):
+    (tmp_path / "deadbeef.jxp").write_bytes(b"jxp-bytes")
+    agent = ReplicaAgent(lambda argv: _Handle(), cache_dir=str(tmp_path),
+                         max_replicas=1).start()
+    try:
+        code, text = _http(agent.url + "/a/cache/deadbeef.jxp")
+        assert code == 200 and text == "jxp-bytes"
+        code, _ = _http(agent.url + "/a/cache/cafecafe.jxp")   # absent
+        assert code == 404
+        code, _ = _http(agent.url + "/a/cache/..%2Fetc%2Fpasswd")
+        assert code == 404                                      # bad name
+        h = agent.health()
+        assert h["cache_requests_total"] == 3
+        assert h["cache_hits_total"] == 1
+    finally:
+        agent.stop()
+
+
+# -- lease-based remote supervision ------------------------------------------
+
+def _remote_fleet(client, handle, **kw):
+    router = Router([handle.url], poll_interval_s=3600.0).start()
+    kw.setdefault("backoff_fn", lambda attempt: 0.0)
+    sup = FleetSupervisor(spawn_fn=None, router=router, initial=[handle],
+                          min_replicas=1, max_replicas=1,
+                          agents=[client] if not isinstance(client, list)
+                          else client,
+                          remote_argv=["serve"], **kw)
+    return router, sup
+
+
+def test_remote_replica_death_respawns_through_agent():
+    agents, spawned = _start_agents()
+    agent = agents[0]
+    router = sup = None
+    try:
+        client = AgentClient(agent.url, timeout_s=5.0)
+        h = client.spawn(["serve"])
+        router, sup = _remote_fleet(client, h)
+        spawned[0].die(rc=-9)
+        sup.tick()        # heartbeat refreshes the snapshot; reap
+        st = sup.stats()
+        assert st["states"]["backoff"] == 1
+        assert st["slots"][0]["last_exit"] == -9
+        assert len(router.replicas) == 0
+        sup.tick()        # respawn goes THROUGH the agent
+        st = sup.stats()
+        assert st["states"]["running"] == 1
+        assert st["restarts_total"] == 1
+        assert st["slots"][0]["agent"] == client.url
+        assert agent.health()["spawns_total"] == 2
+        assert len(router.replicas) == 1
+    finally:
+        if sup:
+            sup.stop()
+        if router:
+            router.stop()
+        _stop_agents(agents)
+
+
+def test_lease_partition_holds_slots_then_heal_adopts_no_double_spawn():
+    agents, spawned = _start_agents()
+    agent = agents[0]
+    router = sup = None
+    try:
+        client = AgentClient(agent.url, timeout_s=5.0)
+        h = client.spawn(["serve"])
+        router, sup = _remote_fleet(client, h, lease_misses=2,
+                                    agent_failover_s=1e9)
+        sup.tick()                              # healthy lease
+        assert sup.stats()["states"]["running"] == 1
+        faults.arm("agent.partition", "raise", times=3)
+        sup.tick()                              # miss 1: lease holds
+        assert sup.stats()["states"]["running"] == 1
+        sup.tick()                              # miss 2: partitioned
+        st = sup.stats()
+        assert st["states"]["partitioned"] == 1
+        assert st["partitions_total"] == 1
+        assert len(router.replicas) == 0        # out of rotation...
+        sup.tick()                              # miss 3: held, no respawn
+        assert sup.stats()["states"]["partitioned"] == 1
+        assert agent.health()["spawns_total"] == 1   # ...but NOT respawned
+        sup.tick()                              # plan exhausted: heal
+        st = sup.stats()
+        assert st["states"]["running"] == 1
+        assert st["adopted_total"] == 1
+        assert len(router.replicas) == 1
+        # zero double-spawns: reconcile ADOPTED the live replica
+        assert agent.health()["spawns_total"] == 1
+        assert agent.health()["replicas"] == 1
+        ag = st["agents"][0]
+        assert ag["state"] == "leased" and ag["reconciles_total"] == 1
+    finally:
+        if sup:
+            sup.stop()
+        if router:
+            router.stop()
+        _stop_agents(agents)
+
+
+class _FlakyClient(AgentClient):
+    """AgentClient whose heartbeat can be switched off: a partition
+    between supervisor and ONE healthy agent, injected per-client."""
+
+    offline = False
+
+    def refresh(self):
+        if self.offline:
+            raise OSError("injected partition")
+        return super().refresh()
+
+
+def test_partition_failover_lands_on_survivor_then_heal_stops_orphan():
+    agents, spawned = _start_agents(n_agents=2)
+    a0, a1 = agents
+    router = sup = None
+    try:
+        clients = [_FlakyClient(a.url, timeout_s=5.0) for a in agents]
+        clk = _FakeClock()
+        h = clients[0].spawn(["serve"])
+        router, sup = _remote_fleet(clients, h, lease_misses=1,
+                                    agent_failover_s=30.0, clock=clk)
+        clients[0].offline = True
+        sup.tick()                      # 1 miss -> partitioned, held
+        assert sup.stats()["states"]["partitioned"] == 1
+        assert len(router.replicas) == 0
+        assert a1.health()["spawns_total"] == 0
+        clk.t += 31.0
+        sup.tick()                      # past failover: respawn on survivor
+        st = sup.stats()
+        assert st["states"]["running"] == 1
+        assert st["failovers_total"] == 1
+        assert st["slots"][0]["agent"] == clients[1].url
+        assert a1.health()["spawns_total"] == 1
+        assert len(router.replicas) == 1
+        # partition heals: the old child on agent0 is no longer intended
+        # (its slot failed over) — reconcile stops the orphan
+        clients[0].offline = False
+        sup.tick()
+        st = sup.stats()
+        ag0 = next(a for a in st["agents"] if a["url"] == clients[0].url)
+        assert ag0["state"] == "leased"
+        assert ag0["orphans_stopped_total"] == 1
+        assert a0.health()["replicas"] == 0
+        # intent stayed at one replica: exactly one spawn per agent, ever
+        assert a0.health()["spawns_total"] == 1
+        assert a1.health()["spawns_total"] == 1
+        assert st["states"]["running"] == 1
+    finally:
+        if sup:
+            sup.stop()
+        if router:
+            router.stop()
+        _stop_agents(agents)
+
+
+# -- compile-cache distribution (serving/cachesync.py) ------------------------
+
+def _warmed_net_with_store(cache_dir, shapes=(1, 2)):
+    net = MultiLayerNetwork(mlp(n_in=N_IN, hidden=[8], n_out=N_OUT,
+                                lr=0.05), seed=0).init()
+    store = net.set_compile_cache(str(cache_dir))
+    net.warmup(list(shapes))
+    return net, store
+
+
+def test_cold_store_warms_over_the_wire_and_corrupt_fetch_is_counted(
+        tmp_path):
+    warm_net, warm_store = _warmed_net_with_store(tmp_path / "warm")
+    server = CacheServer(str(tmp_path / "warm")).start()
+    try:
+        # cold host, clean wire: every program arrives by fetch, zero
+        # fresh compiles, and the answers match the warm host bitwise
+        cold_net, cold_store = (
+            MultiLayerNetwork(mlp(n_in=N_IN, hidden=[8], n_out=N_OUT,
+                                  lr=0.05), seed=0).init(), None)
+        cold_store = cold_net.set_compile_cache(str(tmp_path / "cold"))
+        cold_store.set_remote(CacheFetcher([server.url], timeout_s=5.0))
+        cold_net.warmup([1, 2])
+        assert cold_store.fetch_hits > 0
+        assert cold_store.fetch_corrupt == 0
+        x = _x(2, seed=3)
+        np.testing.assert_array_equal(np.asarray(cold_net.output(x)),
+                                      np.asarray(warm_net.output(x)))
+        # corrupted fetch: checksum validation rejects it, counts it,
+        # and falls back to compiling — never a crash, never bad bytes
+        cold2 = MultiLayerNetwork(mlp(n_in=N_IN, hidden=[8], n_out=N_OUT,
+                                      lr=0.05), seed=0).init()
+        store2 = cold2.set_compile_cache(str(tmp_path / "cold2"))
+        fetcher = CacheFetcher([server.url], timeout_s=5.0)
+        store2.set_remote(fetcher)
+        faults.arm("agent.cache_fetch", "corrupt", times=1)
+        cold2.warmup([1])
+        assert store2.fetch_corrupt == 1
+        np.testing.assert_array_equal(np.asarray(cold2.output(_x(1))),
+                                      np.asarray(warm_net.output(_x(1))))
+    finally:
+        server.stop()
+
+
+# -- failure-domain-aware hedging ---------------------------------------------
+
+def test_hedge_and_retry_prefer_a_different_host():
+    r1 = Router.__new__(Router)  # only _prefer_other_hosts is exercised
+    mk = lambda host: type("R", (), {"host": host})()  # noqa: E731
+    a, b, c, d = mk("h1"), mk("h1"), mk("h2"), mk("h2")
+    # tail reordered: different-host replicas first, same-host last
+    out = Router._prefer_other_hosts([a, b, c, d])
+    assert [r.host for r in out] == ["h1", "h2", "h2", "h1"]
+    # single-host fleet (or a 2-replica rotation): untouched
+    assert Router._prefer_other_hosts([a, b]) == [a, b]
+    same = [mk("h1"), mk("h1"), mk("h1")]
+    assert Router._prefer_other_hosts(same) == same
+    assert r1 is not None
+
+
+def test_hedge_under_half_open_breaker_counts_probe_outcome_once():
+    """Satellite 4: a hedge fired while the primary's breaker is
+    HALF_OPEN must count the probe outcome exactly once — the hedge's
+    outcome lands on the hedge replica's breaker, the slow probe's own
+    success lands on the primary's, and neither double-transitions."""
+    servers, router = _start_fleet(n=2, hedge=True, hedge_floor_ms=1.0,
+                                   hedge_ceil_ms=50.0)
+    try:
+        assert router.poll_once() == 2
+        primary = router.replicas[0]
+        primary.breaker = CircuitBreaker(failure_threshold=3,
+                                         reset_timeout_s=0.0,
+                                         probe_prob=1.0)
+        for _ in range(3):
+            primary.breaker.record_failure()
+        # reset_timeout 0: tripped, and already reporting HALF_OPEN
+        assert primary.breaker.stats()["state"] == "half_open"
+        assert primary.breaker.stats()["opens"] == 1
+        # reset_timeout 0 + probe_prob 1: the next allow() is a half-open
+        # probe, so the primary re-enters rotation exactly as a probe
+        faults.arm("router.proxy", "delay", delay_s=0.4, nth=1, times=1)
+        code, text = _http(router.url + "/v1/predict",
+                           {"features": _x(1, seed=5).tolist()}, timeout=30)
+        assert code == 200          # the hedge answered while the probe ran
+        st = router.stats()
+        assert st["hedges"] == 1 and st["hedge_wins"] == 1
+        # the delayed probe eventually completes against its replica
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
+            bs = primary.breaker.stats()
+            if bs["successes"] == 1:
+                break
+            time.sleep(0.02)
+        bs = primary.breaker.stats()
+        assert bs["successes"] == 1      # counted exactly once
+        assert bs["state"] == "closed"   # probe success closes it...
+        assert bs["opens"] == 1          # ...with no second transition
+    finally:
+        _stop_all(router, servers)
+
+
 # -- Prometheus conformance ---------------------------------------------------
 
 def test_new_metric_families_parse_and_stay_monotonic():
@@ -588,7 +945,8 @@ def test_new_metric_families_parse_and_stay_monotonic():
         assert parsed1["dl4j_fleet_replicas"][(("state", "running"),)] == 2
         decisions = {dict(lbl)["decision"]
                      for lbl in parsed1["dl4j_autoscaler_decisions_total"]}
-        assert decisions == {"scale_up", "scale_down", "hold"}
+        assert decisions == {"scale_up", "scale_down", "hold",
+                             "hold_partitioned"}
         assert "dl4j_autoscaler_target_replicas" in parsed1
         # traffic + a restart move the counters the right way only
         for i in range(2):
@@ -745,3 +1103,189 @@ def test_cli_fleet_sigkill_heals_with_warm_cache_and_clean_answers(tmp_path):
         if proc.poll() is None:
             proc.kill()
             proc.communicate()
+
+
+def test_cli_multihost_agent_sigkill_and_partition_heal_acceptance(tmp_path):
+    """ISSUE 20 acceptance: the fleet lives on two loopback agent
+    processes (cold caches, warming over the cachesync wire from the
+    control-plane host).  SIGKILL one whole agent mid-load AND inject a
+    lease partition (`agent.partition`) on the survivor's poll path.
+    Every response is a bitwise-correct 200 or a clean JSON 5xx, the
+    failover respawn reaches the survivor with fresh_compiles == 0 and
+    cache_fetch_hits > 0 (warmed over the wire, never compiled), the
+    reconcile never double-spawns (agent /a/replicas live count ==
+    supervisor intent), and SIGTERM drain exits 0."""
+    net = _net()
+    ckpt = str(tmp_path / "model")
+    warm = str(tmp_path / "warm")
+    checkpoint.save(ckpt, net.params, conf=net.conf)
+    x = _x(2, seed=1)
+    expected = np.asarray(net.output(x))
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    subprocess.run(
+        [sys.executable, "-m", "deeplearning4j_tpu.cli", "warmup",
+         "--model", ckpt, "--compile-cache", warm, "--shapes", "1,2"],
+        check=True, capture_output=True, cwd=repo, env=env, timeout=300)
+
+    def start_agent(name):
+        p = subprocess.Popen(
+            [sys.executable, "-m", "deeplearning4j_tpu.cli", "agent",
+             "--port", "0", "--compile-cache", str(tmp_path / name),
+             "--max-replicas", "2"],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            cwd=repo, env=env)
+        watchdog = threading.Timer(120.0, p.kill)
+        watchdog.start()
+        try:
+            startup = json.loads(p.stdout.readline())
+        finally:
+            watchdog.cancel()
+        return p, startup["url"]
+
+    agent_procs = []
+    proc = None
+    replica_pids = []
+    try:
+        a1, u1 = start_agent("cache-a")
+        agent_procs.append(a1)
+        a2, u2 = start_agent("cache-b")
+        agent_procs.append(a2)
+        # the armed partition plan lives in the SERVE process: the fault
+        # point fires twice per supervisor tick (once per agent), so
+        # hits 61..72 partition the survivor for ~6 consecutive beats a
+        # few seconds into the run — long enough to trip the lease
+        # (3 misses), short enough to heal before the failover deadline
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "deeplearning4j_tpu.cli", "serve",
+             "--model", ckpt, "--compile-cache", warm, "--shapes", "1,2",
+             "--replicas", "2", "--min-replicas", "2",
+             "--max-replicas", "2", "--agent", u1, "--agent", u2,
+             "--agent-failover", "4", "--port", "0",
+             "--max-delay-ms", "2", "--drain-timeout", "10"],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            cwd=repo,
+            env={**env, "DL4J_FAULT_PLAN": "agent.partition=raise@61x12"})
+        watchdog = threading.Timer(240.0, proc.kill)
+        watchdog.start()
+        try:
+            summary = json.loads(proc.stdout.readline())
+        finally:
+            watchdog.cancel()
+        url = summary["url"]
+        replica_pids = list(summary["replica_pids"])
+        assert summary["agents"] == [u1, u2]
+        # both initial replicas warmed over the wire from the control
+        # plane's cache server: cold agent disks, zero fresh compiles
+        assert summary["fresh_compiles"] == [0, 0]
+
+        outcomes = {"ok": 0, "err5xx": 0, "bad": []}
+        lock = threading.Lock()
+        stop = threading.Event()
+
+        def client():
+            body = {"features": x.tolist()}
+            while not stop.is_set():
+                try:
+                    code, text = _http(url + "/v1/predict", body,
+                                       timeout=30)
+                except Exception as e:  # noqa: BLE001 — transport drop
+                    with lock:
+                        outcomes["bad"].append(f"transport: {e}")
+                    continue
+                if code == 200:
+                    out = np.asarray(json.loads(text)["output"])
+                    good = np.allclose(out, expected, atol=1e-5)
+                    with lock:
+                        if good:
+                            outcomes["ok"] += 1
+                        else:
+                            outcomes["bad"].append("wrong output")
+                elif 500 <= code < 600:
+                    json.loads(text)  # clean structured error, not junk
+                    with lock:
+                        outcomes["err5xx"] += 1
+                else:
+                    with lock:
+                        outcomes["bad"].append(f"code {code}")
+
+        threads = [threading.Thread(target=client) for _ in range(4)]
+        for t in threads:
+            t.start()
+        time.sleep(0.5)                      # load established
+        a1.kill()                            # chaos 1: a whole host dies
+        healed = None
+        deadline = time.monotonic() + 120.0
+        while time.monotonic() < deadline:
+            try:
+                code, text = _http(url + "/v1/stats", timeout=10)
+                st = json.loads(text)
+            except Exception:  # noqa: BLE001
+                time.sleep(0.2)
+                continue
+            fleet = st.get("fleet", {})
+            survivor = next((a for a in fleet.get("agents", [])
+                             if a["url"] == u2), {})
+            if (st.get("healthy_replicas", 0) >= 2
+                    and fleet.get("failovers_total", 0) >= 1
+                    and survivor.get("partitions_total", 0) >= 1
+                    and survivor.get("state") == "leased"):
+                healed = st
+                break
+            time.sleep(0.2)
+        time.sleep(0.5)                      # post-heal traffic
+        stop.set()
+        for t in threads:
+            t.join(timeout=30.0)
+        assert healed is not None, \
+            "fleet never healed from SIGKILL + partition within 120s"
+        fleet = healed["fleet"]
+        # chaos 2 (the armed plan) really fired AND healed: the survivor
+        # was partitioned, re-leased, and reconciled its replicas back
+        survivor = next(a for a in fleet["agents"] if a["url"] == u2)
+        assert survivor["reconciles_total"] >= 1
+        # the failover respawn warmed over the cachesync wire on the
+        # cold surviving host: fetched, never compiled
+        respawned = [s for s in fleet["slots"] if s["restarts"] >= 1]
+        assert respawned, fleet["slots"]
+        assert all(s["fresh_compiles"] == 0 for s in respawned), respawned
+        assert all(s["cache_fetch_hits"] > 0 for s in respawned), respawned
+        # zero double-spawns after reconcile: the survivor's ACTUAL live
+        # replica count equals the supervisor's intent
+        running = [s for s in fleet["slots"] if s["state"] == "running"]
+        assert len(running) == 2
+        assert all(s["agent"] == u2 for s in running), running
+        code, text = _http(u2 + "/a/replicas", timeout=10)
+        assert code == 200
+        live = [r for r in json.loads(text)["replicas"] if r["alive"]]
+        assert len(live) == len(running) == 2
+        # every client saw a bitwise-correct answer or a clean 5xx
+        assert outcomes["bad"] == [], outcomes["bad"][:5]
+        assert outcomes["ok"] > 0
+
+        proc.send_signal(signal.SIGTERM)
+        out, err = proc.communicate(timeout=180)
+        assert proc.returncode == 0, (out, err)
+        drained = json.loads(out.strip().splitlines()[-1])
+        assert drained["drained"] is True
+        assert all(rc == 0 for rc in drained["replica_exit_codes"])
+        proc = None
+        # the surviving agent drains cleanly too
+        a2.send_signal(signal.SIGTERM)
+        out2, err2 = a2.communicate(timeout=60)
+        assert a2.returncode == 0, (out2, err2)
+    finally:
+        if proc is not None and proc.poll() is None:
+            proc.kill()
+            proc.communicate()
+        for p in agent_procs:
+            if p.poll() is None:
+                p.kill()
+                p.communicate()
+        # the SIGKILLed agent's replica child outlives its parent: reap
+        # it so nothing leaks past the test
+        for pid in replica_pids:
+            try:
+                os.kill(pid, signal.SIGKILL)
+            except OSError:
+                pass

@@ -23,7 +23,8 @@ import pytest
 from deeplearning4j_tpu.models.zoo import mlp
 from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
 from deeplearning4j_tpu.parallel import checkpoint
-from deeplearning4j_tpu.serving import (PRIORITIES, MicroBatcher, Router,
+from deeplearning4j_tpu.serving import (PRIORITIES, FleetSupervisor,
+                                        MicroBatcher, Router,
                                         parse_prometheus_text,
                                         replica_metrics, router_metrics)
 
@@ -141,7 +142,9 @@ def test_replica_metrics_conformance_and_monotonic_counters():
                        "dl4j_serving_request_latency_seconds_bucket",
                        "dl4j_serving_breaker_state",
                        "dl4j_serving_cache_hits_total",
-                       "dl4j_serving_cache_disk_hits_total"):
+                       "dl4j_serving_cache_disk_hits_total",
+                       "dl4j_serving_cache_fetch_hits_total",
+                       "dl4j_serving_cache_fetch_corrupt_total"):
             assert family in parsed1, family
         # priority label present on the latency histogram
         lat = parsed1["dl4j_serving_request_latency_seconds_bucket"]
@@ -169,6 +172,101 @@ def test_metrics_content_type_and_histogram_shape():
     infs = [v for lbl, v in buckets.items() if dict(lbl)["le"] == "+Inf"]
     assert len(infs) == 1
     assert infs[0] == parsed["dl4j_serving_batch_rows_count"][()]
+
+
+def test_quarantine_gauge_and_multihost_families_conformance():
+    """ISSUE 20 satellite: `dl4j_fleet_quarantine_remaining_seconds`
+    counts down on the supervisor's own (injected) clock,
+    `quarantined_until` appears in stats(), and every new multi-host
+    family — fleet partition/failover counters, per-agent lease
+    families, per-host router rollups — renders to strictly parseable
+    text whose counters only move up across scrapes."""
+
+    class _Clock:
+        t = 100.0
+
+        def __call__(self):
+            return self.t
+
+    class _DeadHandle:
+        """A handle that is dead on arrival: one tick books the death
+        and (max_restarts=1) quarantines the slot."""
+
+        url = None
+        summary = None
+
+        def poll(self):
+            return 1
+
+        def wait_ready(self):
+            return {}
+
+        def terminate(self):
+            pass
+
+        def kill(self):
+            pass
+
+        def wait(self, timeout=None):
+            return 1
+
+    clk = _Clock()
+    # one never-polled replica keeps the per-host rollup non-empty; the
+    # agent URL is unreachable, so the first heartbeat partitions it
+    # (lease_misses=1) — every new family gets a non-trivial value
+    router = Router(["http://127.0.0.1:9/dead"],
+                    poll_interval_s=3600.0).start()
+    sup = FleetSupervisor(spawn_fn=_DeadHandle, router=router,
+                          initial=[_DeadHandle()], min_replicas=1,
+                          max_replicas=1, max_restarts=1,
+                          restart_window_s=1000.0, quarantine_s=60.0,
+                          agents=["http://127.0.0.1:9/agent"],
+                          remote_argv=["serve"], lease_misses=1,
+                          agent_failover_s=1e9, clock=clk)
+    try:
+        sup.tick()
+        st = sup.stats()
+        assert st["states"]["quarantined"] == 1
+        slot = st["slots"][0]
+        assert slot["quarantined_until"] == pytest.approx(160.0)
+        assert slot["quarantine_remaining_s"] == pytest.approx(60.0)
+        assert st["agents"][0]["state"] == "partitioned"
+        router.attach_fleet(sup)
+        text1 = router_metrics(router.stats())
+        parsed1 = parse_prometheus_text(text1)  # strict: raises on junk
+        for fam in ("dl4j_fleet_quarantine_remaining_seconds",
+                    "dl4j_fleet_partitions_total",
+                    "dl4j_fleet_failovers_total",
+                    "dl4j_router_host_replicas",
+                    "dl4j_router_host_breaker_opens_total",
+                    "dl4j_agent_up", "dl4j_agent_replicas",
+                    "dl4j_agent_partitions_total",
+                    "dl4j_agent_reconciles_total",
+                    "dl4j_agent_adopted_total",
+                    "dl4j_agent_orphans_stopped_total",
+                    "dl4j_agent_failovers_total"):
+            assert fam in parsed1, fam
+        q = parsed1["dl4j_fleet_quarantine_remaining_seconds"]
+        assert q[(("slot", "0"),)] == pytest.approx(60.0)
+        assert parsed1["dl4j_fleet_partitions_total"][()] == 1
+        assert parsed1["dl4j_router_host_replicas"][
+            (("host", "local"),)] == 1
+        (agent_lbl,) = parsed1["dl4j_agent_up"]
+        assert dict(agent_lbl).keys() == {"agent"}   # label set stable
+        assert parsed1["dl4j_agent_up"][agent_lbl] == 0  # partitioned
+        # the gauge counts DOWN on the supervisor's clock while every
+        # counter stays monotonic
+        clk.t += 25.0
+        sup.tick()
+        parsed2 = parse_prometheus_text(router_metrics(router.stats()))
+        _assert_monotonic(parsed1, parsed2)
+        assert parsed2["dl4j_fleet_quarantine_remaining_seconds"][
+            (("slot", "0"),)] == pytest.approx(35.0)
+        assert (sup.stats()["slots"][0]["quarantined_until"]
+                == pytest.approx(160.0))
+    finally:
+        sup.stop()
+        router.stop()
 
 
 def test_generation_metrics_conformance_and_monotonic(tmp_path):
